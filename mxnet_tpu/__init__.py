@@ -1,0 +1,61 @@
+"""mxnet_tpu — a TPU-native deep learning framework with the capabilities
+of Apache MXNet (the reference `grandave99/mxnet`), built from scratch on
+JAX/XLA/Pallas. See SURVEY.md for the blueprint and the parity citations in
+each module's docstring.
+
+Top-level namespace parity: `import mxnet_tpu as mx` gives mx.nd, mx.np,
+mx.autograd, mx.gluon, mx.cpu()/mx.tpu()/mx.gpu(), mx.random, mx.optimizer,
+mx.metric, mx.init(ializer), mx.profiler, mx.kv(store).
+"""
+__version__ = "0.1.0"
+
+from .base import MXNetError  # noqa: F401
+from .device import (  # noqa: F401
+    Context, Device, cpu, cpu_pinned, cpu_shared, gpu, tpu,
+    num_gpus, num_tpus, current_context, current_device, default_device,
+)
+from . import autograd  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from .ndarray.ndarray import NDArray, waitall  # noqa: F401
+from .ops import random  # noqa: F401
+from . import rng  # noqa: F401
+
+# array constructor parity: mx.nd.array
+from .ndarray import array  # noqa: F401
+
+
+def __getattr__(name):
+    # heavier subsystems load lazily to keep `import mxnet_tpu` fast
+    import importlib
+    lazy = {
+        "np": "mxnet_tpu.numpy",
+        "npx": "mxnet_tpu.numpy_extension",
+        "gluon": "mxnet_tpu.gluon",
+        "optimizer": "mxnet_tpu.optimizer",
+        "metric": "mxnet_tpu.metric",
+        "initializer": "mxnet_tpu.initializer",
+        "init": "mxnet_tpu.initializer",
+        "lr_scheduler": "mxnet_tpu.lr_scheduler",
+        "kv": "mxnet_tpu.kvstore",
+        "kvstore": "mxnet_tpu.kvstore",
+        "profiler": "mxnet_tpu.profiler",
+        "parallel": "mxnet_tpu.parallel",
+        "amp": "mxnet_tpu.amp",
+        "io": "mxnet_tpu.io",
+        "recordio": "mxnet_tpu.io.recordio",
+        "image": "mxnet_tpu.image",
+        "test_utils": "mxnet_tpu.test_utils",
+        "symbol": "mxnet_tpu.symbol",
+        "sym": "mxnet_tpu.symbol",
+        "runtime": "mxnet_tpu.runtime",
+        "engine": "mxnet_tpu.engine",
+        "context": "mxnet_tpu.device",
+        "functional": "mxnet_tpu.functional",
+        "models": "mxnet_tpu.models",
+    }
+    if name in lazy:
+        mod = importlib.import_module(lazy[name])
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

@@ -1,0 +1,360 @@
+"""Imperative autograd: record/pause scopes, tape, backward.
+
+Reference parity: python/mxnet/autograd.py + src/imperative/imperative.cc
+(Imperative::RecordOp / Imperative::Backward, AGInfo tape nodes).
+
+Design (SURVEY.md §7.2 M2): the reference builds an nnvm tape and runs the
+Gradient pass over per-op FGradient entries. Here, every eager op executed
+under `record()` whose inputs are on-tape runs through `jax.vjp`; the
+returned vjp closure (holding XLA-resident residuals) *is* the tape node.
+`backward()` walks the tape in reverse topological order feeding cotangents
+through each node's vjp closure, accumulating into leaf `.grad` buffers per
+their `grad_req` ('write'|'add'|'null'). This preserves the reference's
+user-visible semantics (partial graphs from arbitrary heads, grad_req=add
+accumulation across backward calls, train/predict mode scopes) while the
+actual differentiation is JAX's.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .base import MXNetError
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_state = _State()
+
+
+def is_recording() -> bool:
+    return _state.recording
+
+
+def is_training() -> bool:
+    return _state.training
+
+
+def set_recording(flag: bool) -> bool:
+    prev, _state.recording = _state.recording, flag
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    prev, _state.training = _state.training, flag
+    return prev
+
+
+class _Scope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec = recording
+        self._train = training
+
+    def __enter__(self):
+        self._prev_rec = set_recording(self._rec) if self._rec is not None else None
+        self._prev_train = set_training(self._train) if self._train is not None else None
+        return self
+
+    def __exit__(self, *exc):
+        if self._rec is not None:
+            set_recording(self._prev_rec)
+        if self._train is not None:
+            set_training(self._prev_train)
+
+
+def record(train_mode: bool = True) -> _Scope:
+    """Scope in which executed ops are taped (parity: autograd.record)."""
+    return _Scope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _Scope:
+    return _Scope(False, train_mode)
+
+
+def train_mode() -> _Scope:
+    return _Scope(None, True)
+
+
+def predict_mode() -> _Scope:
+    return _Scope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+class Node:
+    """One taped op: the jax.vjp closure plus graph edges.
+
+    parents[i] describes where input i came from:
+      ('node', Node, out_idx)  — produced by another taped op
+      ('leaf', NDArray)        — a grad-attached variable
+      None                     — constant (no gradient flows)
+    """
+
+    __slots__ = ("name", "vjp_fn", "parents", "out_avals", "saved")
+
+    def __init__(self, name, vjp_fn, parents, out_avals):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.parents = parents
+        self.out_avals = out_avals  # [(shape, dtype)] per output
+        self.saved = None  # set by Function nodes needing extra state
+
+    def release(self):
+        self.vjp_fn = None
+        self.saved = None
+
+
+def tape_entry(arr):
+    """The ('node'|'leaf', ...) provenance of `arr`, or None if constant."""
+    node = arr._node
+    if node is not None:
+        return node
+    if arr._grad_req != "null":
+        return ("leaf", arr)
+    return None
+
+
+def is_tracked(arr) -> bool:
+    return arr._node is not None or arr._grad_req != "null"
+
+
+def record_node(name, vjp_fn, input_arrays, output_arrays):
+    parents = tuple(tape_entry(a) for a in input_arrays)
+    out_avals = tuple((o.shape, o.dtype) for o in output_arrays)
+    node = Node(name, vjp_fn, parents, out_avals)
+    for i, o in enumerate(output_arrays):
+        o._node = ("node", node, i)
+    return node
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+def _toposort(head_nodes):
+    order, seen = [], set()
+    stack = [(n, False) for n in head_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for p in node.parents:
+            if p is not None and p[0] == "node":
+                stack.append((p[1], False))
+    return order  # parents before children
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run backward from `heads` (parity: mx.autograd.backward).
+
+    head_grads: matching list of NDArray/None; None means ones_like (the
+    reference uses ones for scalar-loss convenience).
+    """
+    from .ndarray.ndarray import NDArray  # cycle-free at call time
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and isinstance(head_grads, NDArray):
+            head_grads = [head_grads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    if len(head_grads) != len(heads):
+        raise MXNetError("head_grads length mismatch")
+
+    # Seed cotangents keyed by (id(node), out_idx).
+    cts = {}
+    leaf_cts = {}  # id(arr) -> (arr, cotangent)
+    head_nodes = []
+    for h, hg in zip(heads, head_grads):
+        entry = tape_entry(h)
+        if entry is None:
+            raise MXNetError(
+                "cannot differentiate: head is not on the tape "
+                "(was it computed under autograd.record()?)"
+            )
+        g = hg._data if hg is not None else jnp.ones(h.shape, h.dtype)
+        if entry[0] == "leaf":
+            arr = entry[1]
+            _accum(leaf_cts, id(arr), arr, g)
+            continue
+        _, node, idx = entry
+        key = (id(node), idx)
+        cts[key] = cts[key] + g if key in cts else g
+        head_nodes.append(node)
+
+    order = _toposort(head_nodes)
+    for node in reversed(order):  # children before parents
+        outs = []
+        missing = True
+        for i, (shape, dtype) in enumerate(node.out_avals):
+            ct = cts.pop((id(node), i), None)
+            if ct is None:
+                ct = jnp.zeros(shape, dtype)
+            else:
+                missing = False
+            outs.append(ct)
+        if missing:
+            continue  # no gradient reached this node
+        if node.vjp_fn is None:
+            raise MXNetError(
+                "tape already consumed; pass retain_graph=True to backward() "
+                "to keep it (parity: MXNet frees the graph after backward)"
+            )
+        in_cts = node.vjp_fn(tuple(outs) if len(outs) > 1 else outs[0])
+        for parent, ct in zip(node.parents, in_cts):
+            if parent is None or ct is None:
+                continue
+            if hasattr(ct, "dtype") and ct.dtype == jax.dtypes.float0:
+                continue
+            if parent[0] == "leaf":
+                _accum(leaf_cts, id(parent[1]), parent[1], ct)
+            else:
+                _, pnode, pidx = parent
+                key = (id(pnode), pidx)
+                cts[key] = cts[key] + ct if key in cts else ct
+        if not retain_graph:
+            node.release()
+
+    # Write accumulated cotangents into leaf .grad buffers.
+    for _, (arr, ct) in leaf_cts.items():
+        req = arr._grad_req
+        if req == "null":
+            continue
+        ct = jnp.asarray(ct, arr.dtype)
+        if req == "add" and arr._grad is not None:
+            arr._grad._data = arr._grad._data + ct
+        else:  # 'write'
+            if arr._grad is None:
+                arr._grad = NDArray(ct)
+            else:
+                arr._grad._data = ct
+
+
+def _accum(store, key, arr, ct):
+    if key in store:
+        store[key] = (arr, store[key][1] + ct)
+    else:
+        store[key] = (arr, ct)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Parity: mx.autograd.grad — return grads w.r.t. `variables` instead of
+    writing into .grad buffers. create_graph (higher-order) is supported via
+    the functional path only and raises here; use mxnet_tpu.functional.grad.
+    """
+    from .ndarray.ndarray import NDArray
+
+    if create_graph:
+        raise MXNetError(
+            "create_graph=True (higher-order grad through the imperative tape) "
+            "is not supported; use the functional API (mx.functional.grad), "
+            "which composes jax.grad arbitrarily deep"
+        )
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    saved = [(v._grad_req, v._grad) for v in variables]
+    for v in variables:
+        v._grad_req = "write"
+        v._grad = None
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph),
+                 train_mode=train_mode)
+        out = []
+        for v in variables:
+            if v._grad is None:
+                out.append(NDArray(jnp.zeros(v.shape, v.dtype)))
+            else:
+                out.append(v._grad)
+    finally:
+        for v, (req, g) in zip(variables, saved):
+            v._grad_req = req
+            v._grad = g
+    return out[0] if single else out
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Parity: autograd.mark_variables."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, r in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = r
+
+
+def get_symbol(x):
+    raise MXNetError(
+        "autograd.get_symbol is not supported: the tape records jax.vjp "
+        "closures, not nnvm symbols; use HybridBlock.export for graphs"
+    )
+
+
+class Function:
+    """User-defined differentiable function (parity: mx.autograd.Function).
+
+    Subclass and implement forward(self, *inputs) and backward(self,
+    *output_grads), both taking/returning NDArrays. Reference:
+    python/mxnet/autograd.py — Function / c_api_function.cc.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = isinstance(outputs, NDArray)
+        outs = [outputs] if single else list(outputs)
+        nd_positions = [i for i, a in enumerate(inputs) if isinstance(a, NDArray)]
+        if is_recording() and any(is_tracked(inputs[i]) for i in nd_positions):
+            func = self
+            n_in = len(inputs)
+
+            def vjp_fn(out_cts):
+                if not isinstance(out_cts, tuple):
+                    out_cts = (out_cts,)
+                with pause():
+                    in_grads = func.backward(*[NDArray(c) for c in out_cts])
+                if isinstance(in_grads, NDArray):
+                    in_grads = (in_grads,)
+                if len(in_grads) != n_in:
+                    raise MXNetError(
+                        f"{type(func).__name__}.backward returned "
+                        f"{len(in_grads)} grads for {n_in} inputs")
+                return tuple(in_grads[i]._data if in_grads[i] is not None
+                             else None for i in nd_positions)
+
+            record_node(type(self).__name__, vjp_fn,
+                        [inputs[i] for i in nd_positions], outs)
+        return outputs

@@ -1,0 +1,108 @@
+"""Base utilities: errors, registries, global knobs.
+
+Reference parity: python/mxnet/base.py (MXNetError, check_call machinery).
+The reference funnels every failure through a flat C API into MXNetError;
+here there is no FFI boundary, so MXNetError is simply the framework's root
+exception type, raised directly from Python/JAX code.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+
+class MXNetError(RuntimeError):
+    """Root exception for the framework (parity: mxnet.base.MXNetError)."""
+
+
+class NotSupportedForTPUError(MXNetError):
+    """Raised for reference features intentionally de-scoped on TPU.
+
+    Each raise site documents the de-scope rationale (SURVEY.md §7.1 table).
+    """
+
+
+def getenv_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def getenv_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+class _ThreadLocalScopes(threading.local):
+    """Thread-local stack holder used by scoped state (autograd, name scopes)."""
+
+    def __init__(self):
+        self.stacks = {}
+
+    def stack(self, key):
+        return self.stacks.setdefault(key, [])
+
+
+_scopes = _ThreadLocalScopes()
+
+
+def push_scope(key, value):
+    _scopes.stack(key).append(value)
+
+
+def pop_scope(key):
+    return _scopes.stack(key).pop()
+
+
+def current_scope(key, default=None):
+    s = _scopes.stack(key)
+    return s[-1] if s else default
+
+
+class Registry:
+    """Minimal name->object registry (parity: dmlc registry pattern).
+
+    The reference registers operators, initializers, optimizers and metrics
+    in global C++/Python registries; this is the shared Python equivalent.
+    """
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._map = {}
+
+    def register(self, name=None, *, aliases=()):
+        def _do(obj, name=name):
+            if name is None:
+                name = obj.__name__.lower()
+            key = name.lower()
+            if key in self._map and self._map[key] is not obj:
+                raise MXNetError(f"duplicate {self.kind} registration: {name}")
+            self._map[key] = obj
+            for a in aliases:
+                self._map[a.lower()] = obj
+            return obj
+
+        if callable(name) and not isinstance(name, str):
+            obj, name = name, None
+            return _do(obj)
+        return _do
+
+    def get(self, name):
+        try:
+            return self._map[name.lower()]
+        except KeyError:
+            raise MXNetError(
+                f"unknown {self.kind} '{name}'; registered: {sorted(self._map)}"
+            ) from None
+
+    def create(self, name, *args, **kwargs):
+        return self.get(name)(*args, **kwargs)
+
+    def __contains__(self, name):
+        return name.lower() in self._map
+
+    def keys(self):
+        return sorted(self._map)

@@ -1,0 +1,183 @@
+"""Device / Context layer.
+
+Reference parity: python/mxnet/context.py — Context, cpu()/gpu()/cpu_pinned(),
+current_context (v2: device.py). The north-star brief adds `tpu()` as a
+first-class context; here TPU is the *primary* accelerator and `gpu()` is an
+alias kept for script compatibility (it resolves to the accelerator backend,
+which on this stack is TPU).
+
+Arrays are placed by handing the underlying jax.Array to `jax.device_put`
+with the resolved `jax.Device`; there is no custom storage manager — PjRt's
+HBM allocator plays the role of src/storage/pooled_storage_manager.h
+(SURVEY.md §7.1: "No — expose memory stats API only").
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from .base import MXNetError, current_scope, pop_scope, push_scope
+
+_SCOPE_KEY = "device"
+
+
+class Device:
+    """A compute device (parity: mxnet.context.Context).
+
+    devtype strings: 'cpu', 'tpu', 'gpu' (alias of the accelerator platform),
+    'cpu_pinned'/'cpu_shared' (accepted, mapped to 'cpu' — PjRt manages
+    staging/pinned buffers internally).
+    """
+
+    _ALIASES = {"cpu_pinned": "cpu", "cpu_shared": "cpu"}
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        device_type = self._ALIASES.get(device_type, device_type)
+        if device_type not in ("cpu", "tpu", "gpu"):
+            raise MXNetError(f"unknown device type {device_type!r}")
+        self.device_type = device_type
+        self.device_id = int(device_id)
+
+    # -- mxnet Context compat ------------------------------------------------
+    @property
+    def device_typeid(self) -> int:
+        return {"cpu": 1, "gpu": 2, "tpu": 6}[self.device_type]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Device)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    def __enter__(self):
+        push_scope(_SCOPE_KEY, self)
+        return self
+
+    def __exit__(self, *exc):
+        pop_scope(_SCOPE_KEY)
+
+    # -- resolution to jax --------------------------------------------------
+    @property
+    def jax_device(self) -> jax.Device:
+        return _resolve(self.device_type, self.device_id)
+
+    def empty_cache(self):
+        """Parity: mx.Context.empty_cache — no-op; PjRt owns the HBM pool."""
+
+    def memory_info(self):
+        """Free/total HBM if the backend reports it, else (None, None)."""
+        d = self.jax_device
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if not stats:
+            return (None, None)
+        limit = stats.get("bytes_limit")
+        in_use = stats.get("bytes_in_use")
+        free = limit - in_use if (limit is not None and in_use is not None) else None
+        return (free, limit)
+
+
+# Context is the historical name throughout the reference's API surface.
+Context = Device
+
+
+@functools.lru_cache(maxsize=None)
+def _accelerator_platform():
+    """The non-CPU platform jax was initialised with, or None."""
+    try:
+        platforms = {d.platform for d in jax.devices()}
+    except RuntimeError:
+        return None
+    for p in ("tpu", "gpu", "cuda", "rocm"):
+        if p in platforms:
+            return p
+    return None
+
+
+@functools.lru_cache(maxsize=None)
+def _devices_for(platform: str):
+    try:
+        return tuple(jax.devices(platform))
+    except RuntimeError:
+        return ()
+
+
+def _resolve(device_type: str, device_id: int) -> jax.Device:
+    if device_type == "cpu":
+        devs = _devices_for("cpu")
+    else:
+        plat = _accelerator_platform()
+        if plat is None:
+            raise MXNetError(
+                f"no accelerator backend available for {device_type}({device_id}); "
+                "jax was initialised CPU-only"
+            )
+        devs = _devices_for(plat)
+    if not devs:
+        raise MXNetError(f"no devices for {device_type}")
+    if device_id >= len(devs):
+        raise MXNetError(
+            f"{device_type}({device_id}) out of range: {len(devs)} device(s) present"
+        )
+    return devs[device_id]
+
+
+def cpu(device_id: int = 0) -> Device:
+    return Device("cpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Device:
+    return Device("cpu", device_id)
+
+
+def cpu_shared(device_id: int = 0) -> Device:
+    return Device("cpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Device:
+    return Device("tpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Device:
+    """Compatibility alias: reference scripts say mx.gpu(i); on this stack the
+    accelerator is TPU, so gpu(i) resolves to accelerator device i."""
+    return Device("gpu", device_id)
+
+
+def num_tpus() -> int:
+    plat = _accelerator_platform()
+    return len(_devices_for(plat)) if plat == "tpu" else 0
+
+
+def num_gpus() -> int:
+    """Parity: mx.context.num_gpus. Counts accelerator devices (TPU here)."""
+    plat = _accelerator_platform()
+    return len(_devices_for(plat)) if plat else 0
+
+
+def default_device() -> Device:
+    """The ambient device: innermost `with device:` scope, else cpu(0).
+
+    Matches the reference's Context.default_ctx semantics (cpu(0) default).
+    """
+    d = current_scope(_SCOPE_KEY)
+    return d if d is not None else cpu(0)
+
+
+current_context = default_device
+current_device = default_device
+
+
+def from_jax_device(jd: jax.Device) -> Device:
+    if jd.platform == "cpu":
+        return cpu(_devices_for("cpu").index(jd))
+    devs = _devices_for(jd.platform)
+    dt = "tpu" if jd.platform == "tpu" else "gpu"
+    return Device(dt, devs.index(jd))

@@ -1,0 +1,27 @@
+"""mx.nd namespace (parity: python/mxnet/ndarray/).
+
+The reference code-generates ~1000 op stubs from the C++ registry at import
+time (ndarray/register.py); here the op modules are the registry, and this
+module re-exports them under the historical `mx.nd.*` names.
+"""
+from .ndarray import NDArray, array, waitall, from_jax, newaxis  # noqa: F401
+from ..ops.math import *  # noqa: F401,F403
+from ..ops.tensor import *  # noqa: F401,F403
+from ..ops.nn import *  # noqa: F401,F403
+from ..ops.init import (  # noqa: F401
+    zeros, ones, full, empty, arange, linspace, eye, tri, meshgrid, indices,
+)
+from ..ops import math, tensor, nn, init  # noqa: F401
+from ..ops import random  # noqa: F401
+from ..ops.registry import OPS
+
+
+def _populate():
+    g = globals()
+    for name in OPS.keys():
+        if name not in g:
+            g[name] = OPS.get(name)
+
+
+_populate()
+del _populate

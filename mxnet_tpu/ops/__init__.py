@@ -1,0 +1,5 @@
+"""Operator library (parity: src/operator/** op surface, exposed as
+mx.nd.* / mx.np.* through the registry)."""
+from . import registry
+from .registry import OPS, apply_op, get_op, op
+from . import math, tensor, nn, init, random  # noqa: F401 — populate registry

@@ -1,0 +1,80 @@
+"""Creation ops (parity: src/operator/tensor/init_op.cc — zeros/ones/arange,
+python/mxnet/ndarray/ndarray.py creation helpers). Placement uses the
+ambient Device scope (mxnet_tpu.device.default_device) or explicit ctx=.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import device as _device
+
+
+def _place(data, ctx):
+    if ctx is None:
+        ctx = _device.default_device()
+        # cpu(0) default: leave placement to jax unless a scope is active
+        from ..base import current_scope
+        if current_scope("device") is None:
+            return data
+    return jax.device_put(data, ctx.jax_device)
+
+
+def _wrap(data, ctx):
+    from ..ndarray.ndarray import NDArray
+    return NDArray(_place(data, ctx))
+
+
+def zeros(shape, ctx=None, dtype="float32", **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _wrap(jnp.zeros(shape, jnp.dtype(dtype)), ctx)
+
+
+def ones(shape, ctx=None, dtype="float32", **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _wrap(jnp.ones(shape, jnp.dtype(dtype)), ctx)
+
+
+def full(shape, val=None, ctx=None, dtype="float32", fill_value=None, **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    v = val if val is not None else fill_value
+    return _wrap(jnp.full(shape, v, jnp.dtype(dtype)), ctx)
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    out = jnp.arange(start, stop, step, jnp.dtype(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return _wrap(out, ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype="float32"):
+    return _wrap(jnp.linspace(start, stop, int(num), endpoint=endpoint,
+                              dtype=jnp.dtype(dtype)), ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype="float32"):
+    return _wrap(jnp.eye(int(N), int(M) if M else None, k=k,
+                         dtype=jnp.dtype(dtype)), ctx)
+
+
+def tri(N, M=None, k=0, ctx=None, dtype="float32"):
+    return _wrap(jnp.tri(int(N), M, k=k, dtype=jnp.dtype(dtype)), ctx)
+
+
+def meshgrid(*arrays, indexing="xy"):
+    from ..ndarray.ndarray import NDArray
+    datas = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+             for a in arrays]
+    return tuple(NDArray(g) for g in jnp.meshgrid(*datas, indexing=indexing))
+
+
+def indices(dimensions, dtype="int32", ctx=None):
+    return _wrap(jnp.indices(tuple(dimensions), dtype=jnp.dtype(dtype)), ctx)

@@ -1,0 +1,362 @@
+"""Elementwise, reduction and linear-algebra ops.
+
+Reference parity: src/operator/tensor/{elemwise_unary_op_basic,
+elemwise_binary_op_basic, broadcast_reduce_op_value, dot, la_op} and the
+numpy-semantics mirrors in src/operator/numpy/. Kernel bodies are
+jax.numpy/lax — XLA fuses elementwise chains into single TPU kernels, which
+is the idiomatic replacement for the reference's mshadow expression
+templates and the pointwise RTC fusion pass (SURVEY.md §7.1).
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+# ---------------------------------------------------------------------------
+# unary elementwise
+# ---------------------------------------------------------------------------
+
+_UNARY = [
+    "negative", "abs", "sign", "rint", "ceil", "floor", "trunc",
+    "square", "sqrt", "cbrt", "exp", "expm1", "log", "log10", "log2",
+    "log1p", "sin", "cos", "tan", "arcsin", "arccos", "arctan", "degrees",
+    "radians", "sinh", "cosh", "tanh", "arcsinh", "arccosh", "arctanh",
+    "reciprocal", "logical_not", "isnan", "isinf", "isfinite", "bitwise_not",
+    "conj", "real", "imag", "angle",
+]
+
+_g = globals()
+for _name in _UNARY:
+    _jfn = getattr(jnp, _name)
+    _g[_name] = op(_name)(
+        (lambda f: (lambda x: f(x)))(_jfn)
+    )
+    _g[_name].__name__ = _name
+
+fix = op("fix")(lambda x: jnp.trunc(x))
+rsqrt = op("rsqrt")(lambda x: lax.rsqrt(x))
+rcbrt = op("rcbrt")(lambda x: 1.0 / jnp.cbrt(x))
+erf = op("erf")(lambda x: jax.scipy.special.erf(x))
+erfinv = op("erfinv")(lambda x: jax.scipy.special.erfinv(x))
+gamma = op("gamma")(lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+gammaln = op("gammaln")(lambda x: jax.scipy.special.gammaln(x))
+digamma = op("digamma")(lambda x: jax.scipy.special.digamma(x))
+sigmoid = op("sigmoid")(lambda x: jax.nn.sigmoid(x))
+relu = op("relu")(lambda x: jax.nn.relu(x))
+softsign = op("softsign")(lambda x: x / (1 + jnp.abs(x)))
+
+# ---------------------------------------------------------------------------
+# binary elementwise (numpy broadcasting)
+# ---------------------------------------------------------------------------
+
+_BINARY = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "power",
+    "maximum", "minimum", "hypot", "arctan2", "equal", "not_equal", "less",
+    "less_equal", "greater", "greater_equal", "logical_and", "logical_or",
+    "logical_xor", "bitwise_and", "bitwise_or", "bitwise_xor", "fmod",
+    "copysign", "ldexp", "nextafter", "left_shift", "right_shift",
+    "true_divide", "float_power", "gcd", "lcm",
+]
+for _name in _BINARY:
+    _jfn = getattr(jnp, _name)
+    _g[_name] = op(_name)(
+        (lambda f: (lambda a, b: f(a, b)))(_jfn)
+    )
+    _g[_name].__name__ = _name
+
+# ---------------------------------------------------------------------------
+# reference-name aliases (legacy mx.nd broadcast_*/elemwise_* surface)
+# ---------------------------------------------------------------------------
+
+broadcast_add = add
+broadcast_plus = add
+broadcast_sub = subtract
+broadcast_minus = subtract
+broadcast_mul = multiply
+broadcast_div = divide
+broadcast_mod = mod
+broadcast_power = power
+broadcast_maximum = maximum
+broadcast_minimum = minimum
+broadcast_equal = equal
+broadcast_not_equal = not_equal
+broadcast_greater = greater
+broadcast_greater_equal = greater_equal
+broadcast_lesser = less
+broadcast_lesser_equal = less_equal
+broadcast_logical_and = logical_and
+broadcast_logical_or = logical_or
+broadcast_logical_xor = logical_xor
+broadcast_hypot = hypot
+elemwise_add = add
+elemwise_sub = subtract
+elemwise_mul = multiply
+elemwise_div = divide
+
+
+@op("clip")
+def clip(x, a_min=None, a_max=None):
+    return jnp.clip(x, a_min, a_max)
+
+
+@op("where")
+def where(cond, a, b):
+    return jnp.where(cond, a, b)
+
+
+@op("add_n")
+def add_n(*args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+ElementWiseSum = add_n
+elemwise_sum = add_n
+
+
+@op("smooth_l1")
+def smooth_l1(x, scalar=1.0):
+    s2 = scalar * scalar
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0 / s2, 0.5 * s2 * x * x, ax - 0.5 / s2)
+
+
+@op("logaddexp")
+def logaddexp(a, b):
+    return jnp.logaddexp(a, b)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+def _norm_axis(axis):
+    if isinstance(axis, list):
+        return tuple(axis)
+    return axis
+
+
+@op("sum")
+def sum(x, axis=None, keepdims=False, dtype=None, exclude=False):
+    axis = _exclude(x, axis, exclude)
+    return jnp.sum(x, axis=_norm_axis(axis), keepdims=keepdims, dtype=dtype)
+
+
+def _exclude(x, axis, exclude):
+    if not exclude:
+        return axis
+    if axis is None:
+        return ()
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    return tuple(i for i in range(x.ndim) if i not in axis)
+
+
+@op("mean")
+def mean(x, axis=None, keepdims=False, dtype=None, exclude=False):
+    axis = _exclude(x, axis, exclude)
+    return jnp.mean(x, axis=_norm_axis(axis), keepdims=keepdims, dtype=dtype)
+
+
+@op("prod")
+def prod(x, axis=None, keepdims=False):
+    return jnp.prod(x, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@op("max")
+def max(x, axis=None, keepdims=False):
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@op("min")
+def min(x, axis=None, keepdims=False):
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@op("var")
+def var(x, axis=None, ddof=0, keepdims=False):
+    return jnp.var(x, axis=_norm_axis(axis), ddof=ddof, keepdims=keepdims)
+
+
+@op("std")
+def std(x, axis=None, ddof=0, keepdims=False):
+    return jnp.std(x, axis=_norm_axis(axis), ddof=ddof, keepdims=keepdims)
+
+
+@op("nansum")
+def nansum(x, axis=None, keepdims=False):
+    return jnp.nansum(x, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@op("nanprod")
+def nanprod(x, axis=None, keepdims=False):
+    return jnp.nanprod(x, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@op("cumsum")
+def cumsum(x, axis=None, dtype=None):
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+@op("cumprod")
+def cumprod(x, axis=None, dtype=None):
+    return jnp.cumprod(x, axis=axis, dtype=dtype)
+
+
+@op("logsumexp")
+def logsumexp(x, axis=None, keepdims=False):
+    return jax.scipy.special.logsumexp(x, axis=_norm_axis(axis),
+                                       keepdims=keepdims)
+
+
+@op("square_sum")
+def square_sum(x, axis=None, keepdims=False):
+    return jnp.sum(x * x, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@op("norm")
+def norm(x, ord=2, axis=None, keepdims=False):
+    if ord == 2 and axis is None:
+        return jnp.sqrt(jnp.sum(x.astype(jnp.float32) ** 2)).astype(x.dtype)
+    return jnp.linalg.norm(x, ord=ord, axis=_norm_axis(axis),
+                           keepdims=keepdims)
+
+
+@op("moments")
+def moments(x, axes=None, keepdims=False):
+    axes = _norm_axis(axes)
+    m = jnp.mean(x, axis=axes, keepdims=keepdims)
+    v = jnp.var(x, axis=axes, keepdims=keepdims)
+    return (m, v)
+
+
+# ---------------------------------------------------------------------------
+# linear algebra — MXU territory: keep these as dot_general so XLA tiles
+# them onto the systolic array (reference: src/operator/tensor/dot.cc via
+# cuBLAS; here XLA emits MXU matmuls directly).
+# ---------------------------------------------------------------------------
+
+@op("dot")
+def dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    return jnp.dot(a, b)
+
+
+@op("batch_dot")
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@op("matmul")
+def matmul(a, b):
+    return jnp.matmul(a, b)
+
+
+@op("einsum")
+def einsum(*operands, optimize=True):
+    # called as einsum("ij,jk->ik", a, b); the subscript string is a static
+    # (non-NDArray) positional arg, closed over by the registry wrapper
+    return jnp.einsum(*operands, optimize=bool(optimize))
+
+
+@op("tensordot")
+def tensordot(a, b, axes=2):
+    return jnp.tensordot(a, b, axes=axes)
+
+
+@op("inner")
+def inner(a, b):
+    return jnp.inner(a, b)
+
+
+@op("outer")
+def outer(a, b):
+    return jnp.outer(a, b)
+
+
+@op("kron")
+def kron(a, b):
+    return jnp.kron(a, b)
+
+
+@op("trace")
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+# linalg_* family (reference: src/operator/tensor/la_op.cc)
+linalg_gemm2 = op("linalg_gemm2")(
+    lambda a, b, transpose_a=False, transpose_b=False, alpha=1.0: alpha * jnp.matmul(
+        jnp.swapaxes(a, -1, -2) if transpose_a else a,
+        jnp.swapaxes(b, -1, -2) if transpose_b else b))
+
+
+@op("linalg_gemm")
+def linalg_gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0):
+    a = jnp.swapaxes(a, -1, -2) if transpose_a else a
+    b = jnp.swapaxes(b, -1, -2) if transpose_b else b
+    return alpha * jnp.matmul(a, b) + beta * c
+
+
+linalg_potrf = op("linalg_potrf")(lambda a: jnp.linalg.cholesky(a))
+linalg_trsm = op("linalg_trsm")(
+    lambda a, b, transpose=False, rightside=False, lower=True, alpha=1.0:
+    _trsm(a, b, transpose, rightside, lower, alpha))
+
+
+def _trsm(a, b, transpose, rightside, lower, alpha):
+    if transpose:
+        a = jnp.swapaxes(a, -1, -2)
+        lower = not lower
+    if rightside:
+        x = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(a, -1, -2), jnp.swapaxes(b, -1, -2), lower=not lower)
+        return alpha * jnp.swapaxes(x, -1, -2)
+    return alpha * jax.scipy.linalg.solve_triangular(a, b, lower=lower)
+
+
+linalg_syrk = op("linalg_syrk")(
+    lambda a, transpose=False, alpha=1.0:
+    alpha * (jnp.matmul(jnp.swapaxes(a, -1, -2), a) if transpose
+             else jnp.matmul(a, jnp.swapaxes(a, -1, -2))))
+linalg_det = op("linalg_det")(lambda a: jnp.linalg.det(a))
+linalg_slogdet = op("linalg_slogdet")(lambda a: tuple(jnp.linalg.slogdet(a)))
+linalg_inverse = op("linalg_inverse")(lambda a: jnp.linalg.inv(a))
+linalg_extractdiag = op("linalg_extractdiag")(
+    lambda a, offset=0: jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1))
+linalg_makediag = op("linalg_makediag")(lambda a, offset=0: _makediag(a, offset))
+
+
+def _makediag(a, offset):
+    n = a.shape[-1] + builtins.abs(offset)
+    base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    r = idx + builtins.max(-offset, 0)
+    c = idx + builtins.max(offset, 0)
+    return base.at[..., r, c].set(a)
+
+
+svd = op("svd")(lambda a, full_matrices=False: tuple(
+    jnp.linalg.svd(a, full_matrices=full_matrices)))
+eigh = op("eigh")(lambda a: tuple(jnp.linalg.eigh(a)))
+qr = op("qr")(lambda a: tuple(jnp.linalg.qr(a)))
+cholesky = linalg_potrf
+solve = op("solve")(lambda a, b: jnp.linalg.solve(a, b))
+lstsq = op("lstsq", nodiff=True)(lambda a, b, rcond=None: tuple(
+    jnp.linalg.lstsq(a, b, rcond=rcond)))
+pinv = op("pinv")(lambda a: jnp.linalg.pinv(a))
+matrix_rank = op("matrix_rank", nodiff=True)(lambda a: jnp.linalg.matrix_rank(a))

@@ -1,0 +1,659 @@
+"""Neural-network core ops.
+
+Reference parity: src/operator/nn/** (convolution, fully_connected,
+batch_norm, layer_norm, group_norm, pooling, activation, softmax, dropout)
+and src/operator/rnn-inl.h (fused RNN). Kernel bodies are XLA primitives:
+conv_general_dilated / dot_general hit the MXU directly (replacing the
+reference's cuDNN/cuBLAS wrappers, SURVEY.md §2.3 row "cuDNN/cuBLAS
+wrappers"), reduce_window replaces pooling kernels, and lax.scan replaces
+the cuDNN fused RNN. Layout is NCHW for API parity; XLA:TPU's layout
+assignment rewrites to its preferred tiling internally.
+"""
+from __future__ import annotations
+
+import builtins
+import math as _pymath
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .. import rng as _rng
+from ..autograd import is_training
+from .registry import op
+
+# ---------------------------------------------------------------------------
+# fully connected / dense
+# ---------------------------------------------------------------------------
+
+@op("FullyConnected")
+def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                   flatten=True):
+    """Parity: src/operator/nn/fully_connected.cc. weight is (num_hidden, K)
+    as in the reference; lowered to dot_general (MXU)."""
+    x = data
+    if flatten and x.ndim > 2:
+        x = jnp.reshape(x, (x.shape[0], -1))
+    y = jnp.matmul(x, weight.T)
+    if bias is not None and not no_bias:
+        y = y + bias
+    return y
+
+
+fully_connected = FullyConnected
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+
+def _tup(v, n):
+    if v is None:
+        return (0,) * n if n else v
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(i) for i in v)
+
+
+@op("Convolution")
+def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False,
+                layout=None, cudnn_tune=None, cudnn_off=False, workspace=None):
+    """Parity: src/operator/nn/convolution.cc. NCHW/OIHW semantics; XLA
+    emits an MXU conv. Supports 1D/2D/3D by kernel rank, grouped conv via
+    feature_group_count."""
+    nd = weight.ndim - 2
+    stride = _tup(stride, nd) or (1,) * nd
+    dilate = _tup(dilate, nd) or (1,) * nd
+    pad = _tup(pad, nd)
+    if builtins.all(s == 0 for s in stride):
+        stride = (1,) * nd
+    spatial = "DHW"[-nd:] if nd <= 3 else None
+    if spatial is None:
+        raise MXNetError(f"unsupported conv rank {nd}")
+    lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    (lhs_spec, rhs_spec, lhs_spec))
+    y = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+    )
+    if y.dtype != data.dtype:
+        y = y.astype(data.dtype)
+    if bias is not None and not no_bias:
+        y = y + jnp.reshape(bias, (1, -1) + (1,) * nd)
+    return y
+
+
+conv = Convolution
+
+
+@op("Deconvolution")
+def Deconvolution(data, weight, bias=None, kernel=None, stride=None,
+                  dilate=None, pad=None, adj=None, target_shape=None,
+                  num_filter=None, num_group=1, no_bias=True, layout=None,
+                  cudnn_tune=None, cudnn_off=False, workspace=None):
+    """Parity: src/operator/nn/deconvolution.cc — gradient of conv w.r.t.
+    input, i.e. transposed convolution."""
+    nd = weight.ndim - 2
+    stride = _tup(stride, nd) or (1,) * nd
+    dilate = _tup(dilate, nd) or (1,) * nd
+    pad = _tup(pad, nd)
+    adj = _tup(adj, nd) or (0,) * nd
+    if num_group != 1:
+        xs = jnp.split(data, num_group, axis=1)
+        ws = jnp.split(weight, num_group, axis=0)
+        parts = [_deconv(x, w, stride, pad, dilate, adj) for x, w in zip(xs, ws)]
+        y = jnp.concatenate(parts, axis=1)
+    else:
+        y = _deconv(data, weight, stride, pad, dilate, adj)
+    if bias is not None and not no_bias:
+        y = y + jnp.reshape(bias, (1, -1) + (1,) * nd)
+    return y
+
+
+def _deconv(x, w, stride, pad, dilate, adj):
+    nd = w.ndim - 2
+    spatial = "DHW"[-nd:]
+    # transposed conv = lhs-dilated conv with flipped kernel, IO swapped
+    w_flip = w
+    for ax in range(2, 2 + nd):
+        w_flip = jnp.flip(w_flip, axis=ax)
+    w_flip = jnp.swapaxes(w_flip, 0, 1)  # (I,O,...) -> treat I as output
+    k = [(w.shape[2 + i] - 1) * dilate[i] for i in range(nd)]
+    padding = [(k[i] - pad[i], k[i] - pad[i] + adj[i]) for i in range(nd)]
+    lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    dn = lax.conv_dimension_numbers(x.shape, w_flip.shape,
+                                    (lhs_spec, rhs_spec, lhs_spec))
+    return lax.conv_general_dilated(
+        x, w_flip, window_strides=(1,) * nd, padding=padding,
+        lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+@op("BatchNorm")
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+              momentum=0.9, fix_gamma=True, use_global_stats=False,
+              output_mean_var=False, axis=1, cudnn_off=False):
+    """Parity: src/operator/nn/batch_norm.cc. Pure-functional: in training
+    returns (y, batch_mean, batch_var); the Gluon layer owns the moving-stat
+    update (the reference mutates them inside the kernel via FMutateInputs —
+    impossible and unnecessary under XLA purity)."""
+    red = tuple(i for i in range(data.ndim) if i != axis)
+    bshape = tuple(data.shape[axis] if i == axis else 1
+                   for i in range(data.ndim))
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    training = is_training() and not use_global_stats
+    if training:
+        x32 = data.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=red)
+        var = jnp.var(x32, axis=red)
+    else:
+        mean, var = moving_mean, moving_var
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps)
+    y = (data - jnp.reshape(mean, bshape).astype(data.dtype)) * \
+        jnp.reshape(inv, bshape).astype(data.dtype) * \
+        jnp.reshape(g, bshape) + jnp.reshape(beta, bshape)
+    if training or output_mean_var:
+        return (y, mean.astype(moving_mean.dtype), var.astype(moving_var.dtype))
+    return y
+
+
+@op("LayerNorm")
+def LayerNorm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    """Parity: src/operator/nn/layer_norm.cc (fast CUDA path → XLA fuses the
+    reductions+scale into one kernel on TPU)."""
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axis, keepdims=True)
+    var = jnp.var(x32, axis=axis, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    y = ((x32 - mean) * inv).astype(data.dtype)
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+    y = y * jnp.reshape(gamma, bshape) + jnp.reshape(beta, bshape)
+    if output_mean_var:
+        return (y, jnp.squeeze(mean, axis), jnp.squeeze(var, axis))
+    return y
+
+
+@op("GroupNorm")
+def GroupNorm(data, gamma, beta, num_groups=1, eps=1e-5,
+              output_mean_var=False):
+    """Parity: src/operator/nn/group_norm.cc. NC+ layout, groups over C."""
+    n, c = data.shape[0], data.shape[1]
+    g = num_groups
+    xg = jnp.reshape(data.astype(jnp.float32), (n, g, c // g, -1))
+    mean = jnp.mean(xg, axis=(2, 3), keepdims=True)
+    var = jnp.var(xg, axis=(2, 3), keepdims=True)
+    y = (xg - mean) * lax.rsqrt(var + eps)
+    y = jnp.reshape(y, data.shape).astype(data.dtype)
+    bshape = (1, c) + (1,) * (data.ndim - 2)
+    y = y * jnp.reshape(gamma, bshape) + jnp.reshape(beta, bshape)
+    if output_mean_var:
+        return (y, jnp.reshape(mean, (n, g)), jnp.reshape(var, (n, g)))
+    return y
+
+
+@op("InstanceNorm")
+def InstanceNorm(data, gamma, beta, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=red, keepdims=True)
+    var = jnp.var(x32, axis=red, keepdims=True)
+    y = ((x32 - mean) * lax.rsqrt(var + eps)).astype(data.dtype)
+    bshape = (1, data.shape[1]) + (1,) * (data.ndim - 2)
+    return y * jnp.reshape(gamma, bshape) + jnp.reshape(beta, bshape)
+
+
+@op("L2Normalization")
+def L2Normalization(data, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+        keep = True
+    elif mode == "channel":
+        red = (1,)
+        keep = True
+    elif mode == "spatial":
+        red = tuple(range(2, data.ndim))
+        keep = True
+    else:
+        raise MXNetError(f"unknown L2Normalization mode {mode}")
+    n = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=keep) + eps)
+    return data / n
+
+
+@op("LRN")
+def LRN(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response norm across channels (NCHW)."""
+    sq = jnp.square(data)
+    half = nsize // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half)) + ((0, 0),) * (data.ndim - 2))
+    ssum = lax.reduce_window(
+        padded, 0.0, lax.add,
+        (1, nsize) + (1,) * (data.ndim - 2),
+        (1, 1) + (1,) * (data.ndim - 2), "valid")
+    return data / jnp.power(knorm + alpha / nsize * ssum, beta)
+
+
+@op("rms_norm")
+def rms_norm(data, gamma, axis=-1, eps=1e-6):
+    """RMSNorm (modern-LLM staple; no reference analog, provided natively)."""
+    x32 = data.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x32), axis=axis, keepdims=True)
+    y = (x32 * lax.rsqrt(ms + eps)).astype(data.dtype)
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+    return y * jnp.reshape(gamma, bshape)
+
+
+# ---------------------------------------------------------------------------
+# activation
+# ---------------------------------------------------------------------------
+
+@op("Activation")
+def Activation(data, act_type="relu"):
+    """Parity: src/operator/nn/activation.cc."""
+    return _act(data, act_type)
+
+
+def _act(x, act_type):
+    if act_type == "relu":
+        return jax.nn.relu(x)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act_type == "tanh":
+        return jnp.tanh(x)
+    if act_type == "softrelu":
+        return jax.nn.softplus(x)
+    if act_type == "softsign":
+        return x / (1 + jnp.abs(x))
+    if act_type == "log_sigmoid":
+        return jax.nn.log_sigmoid(x)
+    if act_type == "mish":
+        return x * jnp.tanh(jax.nn.softplus(x))
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    if act_type == "silu" or act_type == "swish":
+        return jax.nn.silu(x)
+    raise MXNetError(f"unknown act_type {act_type}")
+
+
+@op("LeakyReLU")
+def LeakyReLU(data, gamma=None, act_type="leaky", slope=0.25,
+              lower_bound=0.125, upper_bound=0.334):
+    """Parity: src/operator/leaky_relu.cc (leaky/prelu/elu/selu/gelu/rrelu).
+    rrelu uses the fixed mean slope in inference and sampled slope in
+    training, as the reference does."""
+    x = data
+    if act_type == "leaky":
+        return jnp.where(x > 0, x, slope * x)
+    if act_type == "prelu":
+        g = gamma
+        if g.ndim < x.ndim:
+            g = jnp.reshape(g, (1, -1) + (1,) * (x.ndim - 2))
+        return jnp.where(x > 0, x, g * x)
+    if act_type == "elu":
+        return jnp.where(x > 0, x, slope * (jnp.exp(x) - 1))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1))
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "rrelu":
+        if is_training():
+            k = _rng.next_key()
+            s = jax.random.uniform(k, x.shape, jnp.float32, lower_bound,
+                                   upper_bound).astype(x.dtype)
+        else:
+            s = (lower_bound + upper_bound) / 2.0
+        return jnp.where(x > 0, x, s * x)
+    raise MXNetError(f"unknown LeakyReLU act_type {act_type}")
+
+
+softplus = op("softplus")(lambda x: jax.nn.softplus(x))
+gelu = op("gelu")(lambda x, approximate=False: jax.nn.gelu(x, approximate=approximate))
+silu = op("silu")(lambda x: jax.nn.silu(x))
+hard_sigmoid = op("hard_sigmoid")(
+    lambda x, alpha=0.2, beta=0.5: jnp.clip(alpha * x + beta, 0, 1))
+log_sigmoid = op("log_sigmoid")(lambda x: jax.nn.log_sigmoid(x))
+
+# ---------------------------------------------------------------------------
+# softmax family
+# ---------------------------------------------------------------------------
+
+@op("softmax")
+def softmax(data, length=None, axis=-1, temperature=None, use_length=False):
+    """Parity: src/operator/nn/softmax.cc (incl. masked/length variant)."""
+    x = data
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    if length is not None:
+        pos = jnp.arange(x.shape[axis])
+        bshape = [1] * x.ndim
+        bshape[axis] = x.shape[axis]
+        mask = jnp.reshape(pos, bshape) < jnp.reshape(
+            jnp.asarray(length), (-1,) + (1,) * (x.ndim - 1))
+        x = jnp.where(mask, x, -jnp.inf)
+        out = jax.nn.softmax(x, axis=axis)
+        return jnp.where(mask, out, 0.0)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@op("log_softmax")
+def log_softmax(data, axis=-1, temperature=None):
+    x = data
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@op("masked_softmax")
+def masked_softmax(data, mask, axis=-1, temperature=1.0):
+    x = data / temperature if temperature != 1.0 else data
+    x = jnp.where(mask, x, -jnp.inf)
+    out = jax.nn.softmax(x, axis=axis)
+    return jnp.where(mask, out, 0.0)
+
+
+@op("softmin")
+def softmin(data, axis=-1):
+    return jax.nn.softmax(-data, axis=axis)
+
+
+@op("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    lsm = jax.nn.log_softmax(data, axis=-1)
+    lbl = jnp.asarray(label, jnp.int32)
+    nll = -jnp.take_along_axis(lsm, lbl[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll)
+
+
+@op("SoftmaxOutput")
+def SoftmaxOutput(data, label, grad_scale=1.0, ignore_label=-1,
+                  multi_output=False, use_ignore=False, preserve_shape=False,
+                  normalization="null", out_grad=False, smooth_alpha=0.0):
+    """Legacy symbolic-era op: forward = softmax (the CE gradient part is
+    handled by the loss in Gluon-era code)."""
+    return jax.nn.softmax(data, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+
+@op("Dropout")
+def Dropout(data, p=0.5, mode="training", axes=None, cudnn_off=False):
+    """Parity: src/operator/nn/dropout-inl.h — inverted dropout, engine RNG.
+    Active only in autograd training mode (or mode='always')."""
+    if p <= 0 or (mode != "always" and not is_training()):
+        return data
+    shape = data.shape
+    if axes:
+        shape = tuple(1 if i in axes else s for i, s in enumerate(shape))
+    k = _rng.next_key()
+    keep = jax.random.bernoulli(k, 1.0 - p, shape)
+    return jnp.where(keep, data / (1.0 - p), jnp.zeros((), data.dtype))
+
+
+dropout = Dropout
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+@op("Pooling")
+def Pooling(data, kernel=None, pool_type="max", global_pool=False,
+            stride=None, pad=None, pooling_convention="valid",
+            count_include_pad=True, cudnn_off=False, layout=None):
+    """Parity: src/operator/nn/pooling.cc via lax.reduce_window."""
+    nd = data.ndim - 2
+    if global_pool:
+        red = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            out = jnp.max(data, axis=red, keepdims=True)
+        elif pool_type in ("avg", "sum"):
+            out = (jnp.mean if pool_type == "avg" else jnp.sum)(
+                data, axis=red, keepdims=True)
+        elif pool_type == "lp":
+            out = jnp.power(jnp.sum(jnp.power(jnp.abs(data), 2), axis=red,
+                                    keepdims=True), 0.5)
+        else:
+            raise MXNetError(f"unknown pool_type {pool_type}")
+        return out
+    kernel = _tup(kernel, nd)
+    stride = _tup(stride, nd) or (1,) * nd
+    pad = _tup(pad, nd) or (0,) * nd
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pooling_convention == "full":
+        # ceil-mode output: widen right pad so ceil division is covered
+        extra = []
+        for i in range(nd):
+            in_sz = data.shape[2 + i] + 2 * pad[i]
+            out_sz = _pymath.ceil((in_sz - kernel[i]) / stride[i]) + 1
+            need = (out_sz - 1) * stride[i] + kernel[i] - in_sz
+            extra.append(builtins.max(0, need))
+        padding = ((0, 0), (0, 0)) + tuple(
+            (p, p + e) for p, e in zip(pad, extra))
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
+            jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, padding)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            return s / float(_pymath.prod(kernel))
+        ones = jnp.ones_like(data)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return s / cnt
+    if pool_type == "lp":
+        s = lax.reduce_window(jnp.square(jnp.abs(data)), 0.0, lax.add,
+                              window, strides, padding)
+        return jnp.sqrt(s)
+    raise MXNetError(f"unknown pool_type {pool_type}")
+
+
+pooling = Pooling
+
+
+@op("UpSampling")
+def UpSampling(data, scale=2, sample_type="nearest", num_args=1):
+    """Parity: src/operator/nn/upsampling.cc (nearest)."""
+    if sample_type != "nearest":
+        raise MXNetError("UpSampling bilinear: use contrib.BilinearResize2D")
+    n, c, h, w = data.shape
+    out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    return out
+
+
+@op("BilinearResize2D")
+def BilinearResize2D(data, height=None, width=None, scale_height=None,
+                     scale_width=None, mode="size", align_corners=True):
+    n, c, h, w = data.shape
+    if height is None:
+        height = int(h * scale_height)
+        width = int(w * scale_width)
+    return jax.image.resize(data, (n, c, height, width), method="bilinear")
+
+
+# ---------------------------------------------------------------------------
+# fused RNN (parity: src/operator/rnn-inl.h; implemented as lax.scan)
+# ---------------------------------------------------------------------------
+
+def _gates(mode):
+    return {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+
+
+def unpack_rnn_params(parameters, mode, num_layers, input_size, state_size,
+                      bidirectional=False, proj_size=None):
+    """Unpack the reference's flat cuDNN-layout parameter vector:
+    all weights (per layer, per direction: W_i2h then W_h2h), then all
+    biases (b_i2h then b_h2h). Gate order: LSTM [i,f,g,o], GRU [r,z,n]."""
+    G = _gates(mode)
+    D = 2 if bidirectional else 1
+    H = state_size
+    idx = 0
+    layers = []
+    p = parameters
+    for layer in range(num_layers):
+        I = input_size if layer == 0 else H * D
+        dirs = []
+        for d in range(D):
+            w_i2h = lax.dynamic_slice(p, (idx,), (G * H * I,)).reshape(G * H, I)
+            idx += G * H * I
+            w_h2h = lax.dynamic_slice(p, (idx,), (G * H * H,)).reshape(G * H, H)
+            idx += G * H * H
+            dirs.append({"w_i2h": w_i2h, "w_h2h": w_h2h})
+        layers.append(dirs)
+    for layer in range(num_layers):
+        for d in range(D):
+            b_i2h = lax.dynamic_slice(p, (idx,), (G * H,))
+            idx += G * H
+            b_h2h = lax.dynamic_slice(p, (idx,), (G * H,))
+            idx += G * H
+            layers[layer][d]["b_i2h"] = b_i2h
+            layers[layer][d]["b_h2h"] = b_h2h
+    return layers
+
+
+def rnn_param_size(mode, num_layers, input_size, state_size,
+                   bidirectional=False):
+    G = _gates(mode)
+    D = 2 if bidirectional else 1
+    H = state_size
+    total = 0
+    for layer in range(num_layers):
+        I = input_size if layer == 0 else H * D
+        total += D * (G * H * I + G * H * H + 2 * G * H)
+    return total
+
+
+def _cell_step(mode, params, x, states):
+    """One timestep. x: (B, I); states: (h,) or (h, c)."""
+    G_pre = jnp.matmul(x, params["w_i2h"].T) + params["b_i2h"] + \
+        jnp.matmul(states[0], params["w_h2h"].T) + params["b_h2h"]
+    H = states[0].shape[-1]
+    if mode == "lstm":
+        i, f, g, o = jnp.split(G_pre, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * states[1] + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return h, (h, c)
+    if mode == "gru":
+        # GRU with linear_before_reset=True (cuDNN/reference semantics)
+        xr, xz, xn = jnp.split(jnp.matmul(x, params["w_i2h"].T) +
+                               params["b_i2h"], 3, axis=-1)
+        hr, hz, hn = jnp.split(jnp.matmul(states[0], params["w_h2h"].T) +
+                               params["b_h2h"], 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        h = (1 - z) * n + z * states[0]
+        return h, (h,)
+    act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+    h = act(G_pre)
+    return h, (h,)
+
+
+def _run_layer(mode, params, xs, h0, c0, reverse=False):
+    """xs: (T, B, I). Returns (T, B, H), h_T, c_T."""
+    init = (h0, c0) if mode == "lstm" else (h0,)
+
+    def step(carry, x):
+        out, new = _cell_step(mode, params, x, carry)
+        return new, out
+
+    final, ys = lax.scan(step, init, xs, reverse=reverse)
+    hT = final[0]
+    cT = final[1] if mode == "lstm" else None
+    return ys, hT, cT
+
+
+@op("RNN")
+def RNN(data, parameters, state, state_cell=None, state_size=None,
+        num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+        state_outputs=True, projection_size=None, use_sequence_length=False,
+        sequence_length=None, lstm_state_clip_min=None,
+        lstm_state_clip_max=None):
+    """Parity: src/operator/rnn-inl.h fused RNN. data: (T, B, I); state:
+    (L*D, B, H). Implemented as stacked lax.scan — XLA unrolls/pipelines
+    per-step matmuls onto the MXU (the cuDNN-fused-RNN replacement)."""
+    if projection_size is not None:
+        raise MXNetError("RNN projection_size not supported")
+    T, B, I = data.shape
+    H = state_size
+    D = 2 if bidirectional else 1
+    layers = unpack_rnn_params(parameters, mode, num_layers, I, H,
+                               bidirectional)
+    x = data
+    h_outs, c_outs = [], []
+    for li, dirs in enumerate(layers):
+        h0f = state[li * D]
+        c0f = state_cell[li * D] if mode == "lstm" else None
+        yf, hf, cf = _run_layer(mode, dirs[0], x, h0f, c0f)
+        if bidirectional:
+            h0b = state[li * D + 1]
+            c0b = state_cell[li * D + 1] if mode == "lstm" else None
+            yb, hb, cb = _run_layer(mode, dirs[1], x, h0b, c0b, reverse=True)
+            x = jnp.concatenate([yf, yb], axis=-1)
+            h_outs += [hf, hb]
+            if mode == "lstm":
+                c_outs += [cf, cb]
+        else:
+            x = yf
+            h_outs.append(hf)
+            if mode == "lstm":
+                c_outs.append(cf)
+        if p > 0 and li < num_layers - 1 and is_training():
+            k = _rng.next_key()
+            keep = jax.random.bernoulli(k, 1.0 - p, x.shape)
+            x = jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+    outs = [x]
+    if state_outputs:
+        outs.append(jnp.stack(h_outs, axis=0))
+        if mode == "lstm":
+            outs.append(jnp.stack(c_outs, axis=0))
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+# ---------------------------------------------------------------------------
+# attention (reference: src/operator/contrib/transformer.cu interleaved
+# matmuls — here one fused op; Pallas flash kernel plugs in underneath for
+# long sequences, see mxnet_tpu/ops/attention.py)
+# ---------------------------------------------------------------------------
+
+@op("dot_product_attention")
+def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
+                          dropout_p=0.0):
+    """q,k,v: (B, H, T, D). Baseline XLA path; attention.py provides the
+    flash/ring variants with identical semantics."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / _pymath.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * s
+    logits = logits.astype(jnp.float32)
+    if causal:
+        Tq, Tk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((Tq, Tk), bool), Tk - Tq)
+        logits = jnp.where(cm, logits, -jnp.inf)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
+    w = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0 and is_training():
+        kk = _rng.next_key()
+        keep = jax.random.bernoulli(kk, 1.0 - dropout_p, w.shape)
+        w = jnp.where(keep, w / (1.0 - dropout_p), jnp.zeros((), w.dtype))
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)

@@ -1,0 +1,176 @@
+"""Random sampling ops.
+
+Reference parity: src/operator/random/{sample_op, multisample_op,
+shuffle_op} — engine-managed Philox RNG. Keys come from mxnet_tpu.rng
+(global stream eagerly; functional key scope under tracing).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import rng as _rng
+from ..base import MXNetError
+from .registry import op
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+@op("random_uniform", nodiff=True)
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None):
+    k = _rng.next_key()
+    return jax.random.uniform(k, _shape(shape), jnp.dtype(dtype), low, high)
+
+
+@op("random_normal", nodiff=True)
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None):
+    k = _rng.next_key()
+    return jax.random.normal(k, _shape(shape), jnp.dtype(dtype)) * scale + loc
+
+
+random_normal = normal
+random_uniform = uniform
+
+
+@op("random_randint", nodiff=True)
+def randint(low, high, shape=None, dtype="int32", ctx=None):
+    k = _rng.next_key()
+    return jax.random.randint(k, _shape(shape), low, high, jnp.dtype(dtype))
+
+
+@op("random_gamma", nodiff=True)
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None):
+    k = _rng.next_key()
+    return jax.random.gamma(k, alpha, _shape(shape), jnp.dtype(dtype)) * beta
+
+
+@op("random_exponential", nodiff=True)
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None):
+    k = _rng.next_key()
+    return jax.random.exponential(k, _shape(shape), jnp.dtype(dtype)) * scale
+
+
+@op("random_poisson", nodiff=True)
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None):
+    k = _rng.next_key()
+    return jax.random.poisson(k, lam, _shape(shape)).astype(jnp.dtype(dtype))
+
+
+@op("random_negative_binomial", nodiff=True)
+def negative_binomial(k=1, p=0.5, shape=None, dtype="float32", ctx=None):
+    key = _rng.next_key()
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, k, _shape(shape)) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam).astype(jnp.dtype(dtype))
+
+
+@op("random_generalized_negative_binomial", nodiff=True)
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                  dtype="float32", ctx=None):
+    key = _rng.next_key()
+    k1, k2 = jax.random.split(key)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, _shape(shape)) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam).astype(jnp.dtype(dtype))
+
+
+@op("sample_multinomial", nodiff=True)
+def multinomial(data, shape=1, get_prob=False, dtype="int32"):
+    """Parity: sample_multinomial — data is (..., K) probabilities."""
+    k = _rng.next_key()
+    n = shape if isinstance(shape, int) else int(jnp.prod(jnp.asarray(shape)))
+    logits = jnp.log(jnp.maximum(data, 1e-38))
+    batch = data.shape[:-1]
+    samp = jax.random.categorical(k, logits, axis=-1, shape=(n,) + batch)
+    samp = jnp.moveaxis(samp, 0, -1)  # batch + (n,)
+    out_shape = batch + ((n,) if n > 1 else ())
+    out = jnp.reshape(samp, out_shape).astype(jnp.dtype(dtype))
+    if get_prob:
+        lsm = jax.nn.log_softmax(logits, axis=-1)
+        lp = jnp.take_along_axis(lsm, samp.astype(jnp.int32), axis=-1)
+        return (out, jnp.reshape(lp, out_shape))
+    return out
+
+
+@op("categorical", nodiff=True)
+def categorical(logits, shape=None, axis=-1, dtype="int32"):
+    k = _rng.next_key()
+    return jax.random.categorical(k, logits, axis=axis,
+                                  shape=_shape(shape) or None
+                                  ).astype(jnp.dtype(dtype))
+
+
+@op("shuffle", nodiff=True)
+def shuffle(data, axis=0):
+    k = _rng.next_key()
+    return jax.random.permutation(k, data, axis=axis)
+
+
+@op("random_permutation", nodiff=True)
+def permutation(n, ctx=None, dtype="int32"):
+    k = _rng.next_key()
+    return jax.random.permutation(k, n).astype(jnp.dtype(dtype))
+
+
+@op("bernoulli", nodiff=True)
+def bernoulli(prob=None, logit=None, shape=None, dtype="float32", ctx=None):
+    k = _rng.next_key()
+    if prob is None:
+        prob = jax.nn.sigmoid(logit)
+    s = _shape(shape) if shape is not None else jnp.shape(prob)
+    return jax.random.bernoulli(k, prob, s).astype(jnp.dtype(dtype))
+
+
+@op("sample_gamma", nodiff=True)
+def sample_gamma(alpha, beta, shape=None, dtype="float32"):
+    k = _rng.next_key()
+    s = _shape(shape)
+    full = jnp.shape(alpha) + s if s else jnp.shape(alpha)
+    a = jnp.reshape(alpha, jnp.shape(alpha) + (1,) * len(s)) if s else alpha
+    b = jnp.reshape(beta, jnp.shape(beta) + (1,) * len(s)) if s else beta
+    return (jax.random.gamma(k, jnp.broadcast_to(a, full)) * b
+            ).astype(jnp.dtype(dtype))
+
+
+@op("sample_normal", nodiff=True)
+def sample_normal(mu, sigma, shape=None, dtype="float32"):
+    k = _rng.next_key()
+    s = _shape(shape)
+    full = jnp.shape(mu) + s
+    m = jnp.reshape(mu, jnp.shape(mu) + (1,) * len(s)) if s else mu
+    sd = jnp.reshape(sigma, jnp.shape(sigma) + (1,) * len(s)) if s else sigma
+    return (jax.random.normal(k, full, jnp.dtype(dtype)) * sd + m)
+
+
+@op("sample_uniform", nodiff=True)
+def sample_uniform(low, high, shape=None, dtype="float32"):
+    k = _rng.next_key()
+    s = _shape(shape)
+    full = jnp.shape(low) + s
+    lo = jnp.reshape(low, jnp.shape(low) + (1,) * len(s)) if s else low
+    hi = jnp.reshape(high, jnp.shape(high) + (1,) * len(s)) if s else high
+    u = jax.random.uniform(k, full, jnp.dtype(dtype))
+    return u * (hi - lo) + lo
+
+
+@op("gumbel", nodiff=True)
+def gumbel(shape=None, dtype="float32", ctx=None):
+    k = _rng.next_key()
+    return jax.random.gumbel(k, _shape(shape), jnp.dtype(dtype))
+
+
+@op("laplace", nodiff=True)
+def laplace(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None):
+    k = _rng.next_key()
+    return jax.random.laplace(k, _shape(shape), jnp.dtype(dtype)) * scale + loc
+
+
+def seed(seed_state, ctx=None):
+    _rng.seed(seed_state, ctx)

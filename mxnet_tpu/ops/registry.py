@@ -1,0 +1,128 @@
+"""Operator registry and eager dispatch funnel.
+
+Reference parity: the NNVM op registry + src/imperative/imperative_utils.h
+(SetShapeType / PushFCompute) — the single funnel where every op call becomes
+an execution. Here the funnel is `apply_op`: unwrap NDArrays to jax.Arrays,
+execute the pure-JAX kernel (XLA handles shape/dtype inference, placement and
+async dispatch — the roles of FInferShape/FInferType and the ThreadedEngine),
+and, when the autograd tape is recording, route through `jax.vjp` so the op
+contributes a tape node (the role of FGradient).
+
+Ops are plain Python functions over jax arrays registered via `@op(...)`;
+the registry powers introspection (mx.nd.* surface is generated from it, as
+the reference generates stubs from the C registry).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from ..autograd import is_recording, is_tracked, record_node
+from ..base import MXNetError, Registry
+
+OPS = Registry("operator")
+
+
+def _nd():
+    from ..ndarray import ndarray as _m
+    return _m
+
+
+def apply_op(name, closed_fn, array_args, out=None, nodiff=False):
+    """Execute `closed_fn(*jax_arrays)` with tape integration.
+
+    closed_fn must be a pure function of the positional jax arrays (all
+    static parameters already closed over). Returns NDArray or tuple.
+    """
+    NDArray = _nd().NDArray
+    datas = [a._data for a in array_args]
+    rec = (
+        not nodiff
+        and is_recording()
+        and any(is_tracked(a) for a in array_args)
+    )
+    if rec:
+        out_data, vjp_fn = jax.vjp(closed_fn, *datas)
+    else:
+        out_data = closed_fn(*datas)
+    multi = isinstance(out_data, (tuple, list))
+    out_list = list(out_data) if multi else [out_data]
+    outs = [NDArray(d) for d in out_list]
+    if rec:
+        record_node(name, vjp_fn, array_args, outs)
+    result = tuple(outs) if multi else outs[0]
+    if out is not None:
+        _write_out(out, result)
+        return out
+    return result
+
+
+def _write_out(out, result):
+    NDArray = _nd().NDArray
+    if isinstance(out, NDArray) and isinstance(result, NDArray):
+        out._assign_from(result)
+    elif isinstance(out, (tuple, list)) and isinstance(result, tuple):
+        for o, r in zip(out, result):
+            o._assign_from(r)
+    else:
+        raise MXNetError("mismatched out= structure")
+
+
+def op(name=None, nodiff=False, register=True):
+    """Decorator: turn fn(*args, **kwargs) over jax arrays into a user-facing
+    op over NDArrays. Any positional arg that is an NDArray is treated as a
+    differentiable tensor input; everything else (python scalars, shapes,
+    axis kwargs) is closed over as a static parameter, mirroring the
+    reference's dmlc::Parameter op attributes.
+    """
+
+    def deco(fn, name=name):
+        if name is None:
+            name = fn.__name__
+        NDArray_holder = {}
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            NDArray = NDArray_holder.get("c")
+            if NDArray is None:
+                NDArray = _nd().NDArray
+                NDArray_holder["c"] = NDArray
+            out = kwargs.pop("out", None)
+            nd_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+            arrs = [args[i] for i in nd_pos]
+            if not arrs:
+                # creation-style op: run directly (no tape without tensor in)
+                res = fn(*args, **kwargs)
+                if isinstance(res, (tuple, list)):
+                    res = tuple(NDArray(d) for d in res)
+                else:
+                    res = NDArray(res)
+                if out is not None:
+                    _write_out(out, res)
+                    return out
+                return res
+
+            if kwargs or len(nd_pos) != len(args):
+                sargs = args
+
+                def closed(*datas, _sargs=sargs, _kw=kwargs, _pos=tuple(nd_pos)):
+                    full = list(_sargs)
+                    for i, d in zip(_pos, datas):
+                        full[i] = d
+                    return fn(*full, **_kw)
+            else:
+                closed = fn
+            return apply_op(name, closed, arrs, out=out, nodiff=nodiff)
+
+        wrapper.op_name = name
+        wrapper.raw_fn = fn
+        if register:
+            OPS.register(name)(wrapper)
+        return wrapper
+
+    return deco
+
+
+def get_op(name):
+    return OPS.get(name)

@@ -1,0 +1,618 @@
+"""Shape manipulation, indexing, ordering and creation ops.
+
+Reference parity: src/operator/tensor/{matrix_op, indexing_op, init_op,
+ordering_op, control_flow_op, diag_op, histogram} + numpy mirrors.
+All static-shape friendly: reshape/transpose are XLA metadata ops; gather/
+scatter lower to XLA gather/scatter which TPU executes natively.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import op
+
+# ---------------------------------------------------------------------------
+# shape ops
+# ---------------------------------------------------------------------------
+
+
+def _mx_reshape(shape_in, shape_spec):
+    """Support the reference's magic reshape codes (0 = copy dim, -1 = infer,
+    -2 = copy rest, -3 = merge two, -4 = split) — matrix_op reshape."""
+    spec = tuple(int(s) for s in shape_spec)
+    if not any(s in (0, -2, -3, -4) for s in spec):
+        return spec
+    out = []
+    i = 0  # index into shape_in
+    j = 0
+    while j < len(spec):
+        s = spec[j]
+        if s == 0:
+            out.append(shape_in[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(shape_in[i:]); i = len(shape_in)
+        elif s == -3:
+            out.append(shape_in[i] * shape_in[i + 1]); i += 2
+        elif s == -4:
+            a, b = spec[j + 1], spec[j + 2]
+            j += 2
+            dim = shape_in[i]; i += 1
+            if a == -1:
+                a = dim // b
+            if b == -1:
+                b = dim // a
+            out.extend([a, b])
+        else:
+            out.append(s); i += 1
+        j += 1
+    return tuple(out)
+
+
+@op("reshape")
+def reshape(x, shape=None, reverse=False):
+    return jnp.reshape(x, _mx_reshape(x.shape, shape))
+
+
+@op("transpose")
+def transpose(x, axes=None):
+    return jnp.transpose(x, axes=axes)
+
+
+@op("swapaxes")
+def swapaxes(x, dim1=0, dim2=1):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+SwapAxis = swapaxes
+
+
+@op("flatten")
+def flatten(x):
+    """Parity: mx.nd.flatten — collapse all dims after the first."""
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+Flatten = flatten
+
+
+@op("expand_dims")
+def expand_dims(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@op("squeeze")
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+@op("broadcast_to")
+def broadcast_to(x, shape=None):
+    # reference semantics: 0 in target shape means keep input dim
+    tgt = tuple(int(x.shape[i]) if s == 0 else int(s)
+                for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+@op("broadcast_axis")
+def broadcast_axis(x, axis=None, size=None):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(x.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@op("concat")
+def concat(*args, dim=1, axis=None):
+    return jnp.concatenate(args, axis=dim if axis is None else axis)
+
+
+Concat = concat
+
+
+@op("concatenate")
+def concatenate(*args, axis=0):
+    if len(args) == 1 and isinstance(args[0], (tuple, list)):
+        args = tuple(args[0])
+    return jnp.concatenate(args, axis=axis)
+
+
+@op("stack")
+def stack(*args, axis=0):
+    return jnp.stack(args, axis=axis)
+
+
+@op("split")
+def split(x, num_outputs=None, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+SliceChannel = split
+
+
+@op("split_v2")
+def split_v2(x, indices_or_sections=None, axis=0, squeeze_axis=False):
+    parts = jnp.split(x, indices_or_sections, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@op("tile")
+def tile(x, reps=None):
+    return jnp.tile(x, reps)
+
+
+@op("repeat")
+def repeat(x, repeats=None, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@op("pad")
+def pad(x, pad_width=None, mode="constant", constant_value=0):
+    # reference pad_width is flat (before,after) per axis incl. leading dims
+    if isinstance(pad_width, (list, tuple)) and pad_width and \
+            not isinstance(pad_width[0], (list, tuple)):
+        pw = [(int(pad_width[2 * i]), int(pad_width[2 * i + 1]))
+              for i in range(len(pad_width) // 2)]
+    else:
+        pw = pad_width
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pw, mode=jmode, constant_values=constant_value)
+    return jnp.pad(x, pw, mode=jmode)
+
+
+@op("flip")
+def flip(x, axis=None):
+    return jnp.flip(x, axis=axis)
+
+
+reverse = flip
+
+
+@op("roll")
+def roll(x, shift=None, axis=None):
+    return jnp.roll(x, shift, axis=axis)
+
+
+@op("slice")
+def slice(x, begin=None, end=None, step=None):
+    nd = len(begin)
+    step = step or [1] * nd
+    idx = tuple(
+        builtins.slice(
+            None if begin[i] is None else int(begin[i]),
+            None if end[i] is None else int(end[i]),
+            None if step[i] is None else int(step[i]))
+        for i in range(nd))
+    return x[idx]
+
+
+@op("slice_axis")
+def slice_axis(x, axis=0, begin=0, end=None):
+    idx = [builtins.slice(None)] * x.ndim
+    idx[axis] = builtins.slice(begin, end)
+    return x[tuple(idx)]
+
+
+@op("slice_like")
+def slice_like(x, shape_like, axes=None):
+    tgt = shape_like.shape
+    idx = [builtins.slice(None)] * x.ndim
+    axes_ = range(x.ndim) if axes is None else axes
+    for a in axes_:
+        idx[a] = builtins.slice(0, tgt[a])
+    return x[tuple(idx)]
+
+
+@op("dynamic_slice")
+def dynamic_slice(x, start_indices, slice_sizes=None):
+    return lax.dynamic_slice(x, start_indices, slice_sizes)
+
+
+@op("dynamic_update_slice")
+def dynamic_update_slice(x, update, start_indices):
+    return lax.dynamic_update_slice(x, update, start_indices)
+
+
+@op("atleast_1d")
+def atleast_1d(x):
+    return jnp.atleast_1d(x)
+
+
+@op("atleast_2d")
+def atleast_2d(x):
+    return jnp.atleast_2d(x)
+
+
+@op("diag")
+def diag(x, k=0):
+    return jnp.diag(x, k=k)
+
+
+@op("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@op("tril")
+def tril(x, k=0):
+    return jnp.tril(x, k=k)
+
+
+@op("triu")
+def triu(x, k=0):
+    return jnp.triu(x, k=k)
+
+
+@op("depth_to_space")
+def depth_to_space(x, block_size=2):
+    n, c, h, w = x.shape
+    b = block_size
+    y = jnp.reshape(x, (n, b, b, c // (b * b), h, w))
+    y = jnp.transpose(y, (0, 3, 4, 1, 5, 2))
+    return jnp.reshape(y, (n, c // (b * b), h * b, w * b))
+
+
+@op("space_to_depth")
+def space_to_depth(x, block_size=2):
+    n, c, h, w = x.shape
+    b = block_size
+    y = jnp.reshape(x, (n, c, h // b, b, w // b, b))
+    y = jnp.transpose(y, (0, 3, 5, 1, 2, 4))
+    return jnp.reshape(y, (n, c * b * b, h // b, w // b))
+
+
+# ---------------------------------------------------------------------------
+# casting
+# ---------------------------------------------------------------------------
+
+@op("cast")
+def cast(x, dtype=None):
+    return jnp.asarray(x, dtype=dtype)
+
+
+Cast = cast
+astype = cast
+
+
+@op("amp_cast")
+def amp_cast(x, dtype=None):
+    return jnp.asarray(x, dtype=dtype)
+
+
+@op("amp_multicast")
+def amp_multicast(*args, num_outputs=None, cast_narrow=False):
+    dtypes = [a.dtype for a in args]
+    widths = [jnp.dtype(d).itemsize for d in dtypes]
+    pick = builtins.min(range(len(args)), key=lambda i: widths[i]) \
+        if cast_narrow else builtins.max(range(len(args)), key=lambda i: widths[i])
+    tgt = dtypes[pick]
+    return tuple(jnp.asarray(a, tgt) for a in args)
+
+
+@op("zeros_like")
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@op("ones_like")
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+@op("full_like")
+def full_like(x, fill_value=0):
+    return jnp.full_like(x, fill_value)
+
+
+@op("shape_array", nodiff=True)
+def shape_array(x):
+    return jnp.asarray(x.shape, jnp.int64 if False else jnp.int32)
+
+
+@op("size_array", nodiff=True)
+def size_array(x):
+    return jnp.asarray([x.size], jnp.int32)
+
+
+@op("stop_gradient", nodiff=True)
+def stop_gradient(x):
+    return lax.stop_gradient(x)
+
+
+BlockGrad = stop_gradient
+block_grad = stop_gradient
+
+
+@op("identity")
+def identity(x):
+    return jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# indexing ops
+# ---------------------------------------------------------------------------
+
+@op("take")
+def take(x, indices, axis=0, mode="clip"):
+    jmode = {"clip": "clip", "wrap": "wrap", "raise": "clip"}[mode]
+    return jnp.take(x, jnp.asarray(indices, jnp.int32), axis=axis, mode=jmode)
+
+
+@op("pick")
+def pick(x, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.asarray(index, jnp.int32)
+    idx = jnp.expand_dims(idx, axis) if idx.ndim < x.ndim else idx
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+choose_element_0index = pick
+
+
+@op("take_along_axis")
+def take_along_axis(x, indices, axis=None):
+    return jnp.take_along_axis(x, jnp.asarray(indices, jnp.int32), axis=axis)
+
+
+@op("gather_nd")
+def gather_nd(data, indices):
+    idx = jnp.asarray(indices, jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@op("scatter_nd")
+def scatter_nd(data, indices, shape=None):
+    idx = jnp.asarray(indices, jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shape, data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@op("index_update")
+def index_update(data, indices, value):
+    idx = jnp.asarray(indices, jnp.int32)
+    return data.at[idx].set(value)
+
+
+@op("index_add")
+def index_add(data, indices, value):
+    idx = jnp.asarray(indices, jnp.int32)
+    return data.at[idx].add(value)
+
+
+@op("boolean_mask", nodiff=True)
+def boolean_mask(data, index, axis=0):
+    raise MXNetError(
+        "boolean_mask has data-dependent output shape, unsupported under "
+        "XLA static shapes; use where/compress with a fixed size "
+        "(SURVEY.md §7.3 item 2)")
+
+
+@op("one_hot")
+def one_hot(indices, depth=None, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(jnp.asarray(indices, jnp.int32), depth, dtype=jnp.dtype(dtype))
+    if on_value != 1.0 or off_value != 0.0:
+        oh = oh * (on_value - off_value) + off_value
+    return oh
+
+
+@op("Embedding")
+def Embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
+              sparse_grad=False):
+    """Parity: src/operator/tensor/indexing_op.cc — Embedding. sparse_grad
+    accepted and ignored (dense grads; XLA scatter-add handles the VJP)."""
+    return jnp.take(weight, jnp.asarray(data, jnp.int32), axis=0)
+
+
+embedding = Embedding
+
+
+@op("where_index", nodiff=True)
+def where_index(cond):
+    raise MXNetError("np.where(cond) single-arg has dynamic shape; "
+                     "use argwhere with fixed size or mask arithmetic")
+
+
+@op("sequence_mask")
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    T = data.shape[axis]
+    pos = jnp.arange(T)
+    # data layout: (T, B, ...) for axis=0 or (B, T, ...) for axis=1
+    if axis == 0:
+        mask = pos[:, None] < jnp.asarray(sequence_length)[None, :]
+    else:
+        mask = pos[None, :] < jnp.asarray(sequence_length)[:, None]
+    mask = jnp.reshape(mask, mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+SequenceMask = sequence_mask
+
+
+@op("sequence_last")
+def sequence_last(data, sequence_length=None, use_sequence_length=False,
+                  axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [builtins.slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    sl = jnp.asarray(sequence_length, jnp.int32) - 1
+    if axis == 0:
+        return jnp.take_along_axis(
+            data, sl.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0
+        )[0]
+    return jnp.take_along_axis(
+        data, sl.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1
+    )[:, 0]
+
+
+SequenceLast = sequence_last
+
+
+@op("sequence_reverse")
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                     axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    T = data.shape[axis]
+    pos = jnp.arange(T)
+    sl = jnp.asarray(sequence_length, jnp.int32)
+    if axis != 0:
+        raise MXNetError("sequence_reverse with lengths requires axis=0 (TNC)")
+    rev = jnp.where(pos[:, None] < sl[None, :],
+                    sl[None, :] - 1 - pos[:, None], pos[:, None])
+    return jnp.take_along_axis(
+        data, rev.reshape(rev.shape + (1,) * (data.ndim - 2)), axis=0)
+
+
+SequenceReverse = sequence_reverse
+
+# ---------------------------------------------------------------------------
+# ordering
+# ---------------------------------------------------------------------------
+
+@op("argmax", nodiff=True)
+def argmax(x, axis=None, keepdims=False):
+    out = jnp.argmax(x, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out
+
+
+@op("argmin", nodiff=True)
+def argmin(x, axis=None, keepdims=False):
+    out = jnp.argmin(x, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out
+
+
+@op("sort")
+def sort(x, axis=-1, is_ascend=True):
+    out = jnp.sort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@op("argsort", nodiff=True)
+def argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    out = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return jnp.asarray(out, jnp.dtype(dtype))
+
+
+@op("topk", nodiff=True)
+def topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    if axis != -1 and axis != x.ndim - 1:
+        x = jnp.moveaxis(x, axis, -1)
+    vals, idx = lax.top_k(-x if is_ascend else x, k)
+    if is_ascend:
+        vals = -vals
+    if axis != -1 and axis != x.ndim - 1:
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    idx = jnp.asarray(idx, jnp.dtype(dtype))
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return (vals, idx)
+    if ret_typ == "mask":
+        raise MXNetError("topk ret_typ='mask' not supported")
+
+
+@op("searchsorted", nodiff=True)
+def searchsorted(a, v, side="left"):
+    return jnp.searchsorted(a, v, side=side)
+
+
+@op("unique", nodiff=True)
+def unique(x, size=None):
+    if size is None:
+        raise MXNetError("unique requires static `size=` under XLA; pads with "
+                         "the max element")
+    return jnp.unique(x, size=size)
+
+
+@op("histogram", nodiff=True)
+def histogram(x, bins=10, range=None):
+    h, e = jnp.histogram(x, bins=bins, range=range)
+    return (h, e)
+
+
+@op("bincount", nodiff=True)
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength,
+                        length=minlength if minlength > 0 else None)
+
+
+# ---------------------------------------------------------------------------
+# NDArray __getitem__/__setitem__ support (advanced indexing)
+# ---------------------------------------------------------------------------
+
+def _prep_key(key):
+    from ..ndarray.ndarray import NDArray
+    if isinstance(key, NDArray):
+        return key._data
+    if isinstance(key, tuple):
+        return tuple(_prep_key(k) for k in key)
+    if isinstance(key, list):
+        return jnp.asarray(key)
+    return key
+
+
+def _getitem(arr, key):
+    key = _prep_key(key)
+
+    def fn(x):
+        return x[key]
+
+    from .registry import apply_op
+    return apply_op("getitem", fn, [arr])
+
+
+def _setitem(arr, key, value):
+    from ..ndarray.ndarray import NDArray
+    from ..autograd import is_recording, is_tracked, record_node
+    key = _prep_key(key)
+    is_nd = isinstance(value, NDArray)
+    vdata = value._data if is_nd else value
+
+    def fn(x, *maybe_v):
+        v = maybe_v[0] if maybe_v else vdata
+        if isinstance(key, builtins.slice) and key == builtins.slice(None):
+            return jnp.broadcast_to(jnp.asarray(v, x.dtype), x.shape)
+        return x.at[key].set(v)
+
+    inputs = [arr] + ([value] if is_nd else [])
+    rec = is_recording() and any(is_tracked(a) for a in inputs)
+    if rec:
+        out, vjp_fn = jax.vjp(fn, *[a._data for a in inputs])
+        node_inputs = inputs
+        arr._rebind(out)
+        record_node("setitem", vjp_fn, node_inputs, [arr])
+    else:
+        arr._rebind(fn(*[a._data for a in inputs]))

@@ -1,0 +1,63 @@
+"""RNG state management.
+
+Reference parity: src/common/random_generator.h (per-device Philox streams,
+engine-managed) + mx.random.seed. JAX's threefry/Philox keys are the TPU
+analog; this module owns the ambient key stream.
+
+Two modes:
+  * Eager: a process-global key advanced per draw (`next_key`), seeded by
+    `mx.random.seed(n)` — matching the reference's global-seed semantics.
+  * Traced (inside hybridize/jit): RNG must be functional, so the tracing
+    wrapper installs a `key_scope(base_key)`; draws fold an incrementing
+    counter into the scoped key, keeping the traced program pure while the
+    per-call base key is supplied as a runtime argument (so two calls of a
+    hybridized dropout net differ, as in the reference).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.random as jrandom
+
+
+class _RngState(threading.local):
+    def __init__(self):
+        self.key = jrandom.PRNGKey(0)
+        self.scopes = []  # list of [base_key, counter]
+
+
+_state = _RngState()
+
+
+def seed(seed_state: int, ctx=None):
+    """Parity: mx.random.seed. ctx accepted for API compat (keys are
+    device-agnostic in JAX; placement follows the op)."""
+    _state.key = jrandom.PRNGKey(int(seed_state))
+
+
+def next_key():
+    if _state.scopes:
+        scope = _state.scopes[-1]
+        scope[1] += 1
+        return jrandom.fold_in(scope[0], scope[1])
+    _state.key, sub = jrandom.split(_state.key)
+    return sub
+
+
+class key_scope:
+    """Install a functional base key for draws inside a traced region."""
+
+    def __init__(self, base_key):
+        self.base_key = base_key
+
+    def __enter__(self):
+        _state.scopes.append([self.base_key, 0])
+        return self
+
+    def __exit__(self, *exc):
+        _state.scopes.pop()
+
+
+def in_traced_scope() -> bool:
+    return bool(_state.scopes)

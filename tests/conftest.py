@@ -1,0 +1,32 @@
+"""Test harness configuration.
+
+Parity with the reference's strategy (SURVEY.md §4): XLA:CPU is the
+deviceless test target (the analog of the reference's CPU-as-oracle), with
+an 8-device virtual mesh for multi-chip sharding tests (the analog of
+tests/nightly's multi-process-on-one-box kvstore tests).
+
+Must set XLA flags BEFORE jax initialises, hence this runs at conftest
+import time.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_rng(request):
+    """Parity: tests/python/unittest/common.py with_seed() — deterministic
+    seeding per test, seed logged on failure via -ra output."""
+    import mxnet_tpu as mx
+
+    seed = abs(hash(request.node.nodeid)) % (2 ** 31)
+    mx.random.seed(seed)
+    np.random.seed(seed % (2 ** 31))
+    yield

@@ -1,0 +1,224 @@
+"""Autograd tests (parity: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_simple_backward():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain_rule():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y * x  # x^3 -> dz/dx = 3x^2
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [12.0])
+
+
+def test_multiple_inputs():
+    a = mx.nd.array([1.0, 2.0])
+    b = mx.nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b).sum()
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [3, 4])
+    np.testing.assert_allclose(b.grad.asnumpy(), [1, 2])
+
+
+def test_head_gradient():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 2 * x
+    y.backward(mx.nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [20, 200])
+
+
+def test_grad_req_add():
+    x = mx.nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = 5 * x
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [15.0])
+
+
+def test_grad_req_write_overwrites():
+    x = mx.nd.array([1.0])
+    x.attach_grad()  # write
+    for _ in range(3):
+        with autograd.record():
+            y = 5 * x
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [5.0])
+
+
+def test_detach():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])  # only d(y_const*x)
+
+
+def test_stop_gradient_op():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        z = nd.stop_gradient(x * x) * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_pause_scope():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        with autograd.pause():
+            w = x * 10  # not recorded
+        z = y + w.detach()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_is_training_scopes():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+        assert not autograd.is_recording()
+
+
+def test_autograd_grad_api():
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    (g,) = autograd.grad([y], [x])
+    np.testing.assert_allclose(g.asnumpy(), [6.0])
+    # .grad buffer untouched by grad()
+    np.testing.assert_allclose(x.grad.asnumpy(), [0.0])
+
+
+def test_backward_through_shapes():
+    x = mx.nd.array(np.ones((2, 3), np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x.reshape((3, 2)).transpose().sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.ones((2, 3)))
+
+
+def test_backward_through_concat_split():
+    x = mx.nd.array([[1.0, 2.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.concat(x, x * 2, dim=0)
+        z = y.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [[3.0, 3.0]])
+
+
+def test_retain_graph():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), g1)
+
+
+def test_double_backward_raises_without_retain():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    y.backward()
+    with pytest.raises(mx.MXNetError):
+        y.backward()
+
+
+def test_mutation_during_record():
+    # in-place update on a recorded array routes grads to the new value
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        y += 1
+        z = y * 3
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_custom_function():
+    class MyMul(autograd.Function):
+        def forward(self, a, b):
+            self.save_for_backward(a, b)
+            return a * b
+
+        def backward(self, dout):
+            a, b = self.saved_tensors
+            return dout * b, dout * a
+
+    a = mx.nd.array([2.0])
+    b = mx.nd.array([3.0])
+    a.attach_grad()
+    b.attach_grad()
+    f = MyMul()
+    with autograd.record():
+        c = f(a, b)
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [3.0])
+    np.testing.assert_allclose(b.grad.asnumpy(), [2.0])
+
+
+def test_backward_nonscalar_default_ones():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward()  # ones head grad, MXNet convention
+    np.testing.assert_allclose(x.grad.asnumpy(), [3.0, 3.0])
+
+
+def test_diamond_graph():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        a = x * 2
+        b = x * 3
+        z = a * b  # 6x^2 -> dz = 12x = 24
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [24.0])
+
+
+def test_mark_variables():
+    x = mx.nd.array([2.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])

@@ -1,0 +1,178 @@
+"""NDArray core tests (parity: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_array_creation():
+    a = mx.nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    np.testing.assert_array_equal(a.asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_zeros_ones_full():
+    assert nd.zeros((2, 3)).asnumpy().sum() == 0
+    assert nd.ones((2, 3)).asnumpy().sum() == 6
+    np.testing.assert_array_equal(nd.full((2,), 7).asnumpy(), [7, 7])
+    a = nd.arange(0, 10, 2)
+    np.testing.assert_array_equal(a.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_dtype_and_cast():
+    a = nd.ones((3,), dtype="float32")
+    b = a.astype("float16")
+    assert b.dtype == np.float16
+    c = a.astype(np.int32)
+    assert c.dtype == np.int32
+    bf = a.astype("bfloat16")
+    assert str(bf.dtype) == "bfloat16"
+
+
+def test_arith_broadcast():
+    a = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = mx.nd.array([10.0, 20.0])
+    np.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [13, 24]])
+    np.testing.assert_allclose((a * 2 + 1).asnumpy(), [[3, 5], [7, 9]])
+    np.testing.assert_allclose((1 - a).asnumpy(), [[0, -1], [-2, -3]])
+    np.testing.assert_allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]])
+    np.testing.assert_allclose((a @ a).asnumpy(), [[7, 10], [15, 22]])
+
+
+def test_comparison_ops():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    m = (a > 1.5).asnumpy()
+    np.testing.assert_array_equal(m, [False, True, True])
+
+
+def test_inplace_ops():
+    a = mx.nd.array([1.0, 2.0])
+    aid = id(a)
+    a += 1
+    assert id(a) == aid
+    np.testing.assert_allclose(a.asnumpy(), [2, 3])
+    a *= 2
+    np.testing.assert_allclose(a.asnumpy(), [4, 6])
+
+
+def test_indexing():
+    a = mx.nd.array(np.arange(12).reshape(3, 4))
+    np.testing.assert_array_equal(a[1].asnumpy(), [4, 5, 6, 7])
+    np.testing.assert_array_equal(a[1:3, 0].asnumpy(), [4, 8])
+    np.testing.assert_array_equal(a[:, -1].asnumpy(), [3, 7, 11])
+    idx = mx.nd.array([0, 2], dtype="int32")
+    np.testing.assert_array_equal(a[idx].asnumpy(), [[0, 1, 2, 3], [8, 9, 10, 11]])
+
+
+def test_setitem():
+    a = nd.zeros((3, 3))
+    a[1, 1] = 5.0
+    assert a.asnumpy()[1, 1] == 5.0
+    a[0] = mx.nd.array([1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(a.asnumpy()[0], [1, 2, 3])
+    a[:] = 7.0
+    assert (a.asnumpy() == 7).all()
+
+
+def test_reshape_magic_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert nd.reshape(a, shape=(-3, 4)).shape == (6, 4)
+    assert nd.reshape(a, shape=(0, 0, -1)).shape == (2, 3, 4)
+    assert nd.reshape(a, shape=(-4, 1, 2, 0, 0)).shape == (1, 2, 3, 4)
+    assert nd.reshape(a, shape=(-2,)).shape == (2, 3, 4)
+
+
+def test_shape_ops():
+    a = nd.zeros((2, 3))
+    assert a.T.shape == (3, 2)
+    assert a.expand_dims(0).shape == (1, 2, 3)
+    assert nd.concat(a, a, dim=0).shape == (4, 3)
+    assert nd.stack(a, a, axis=0).shape == (2, 2, 3)
+    parts = nd.split(nd.zeros((4, 6)), num_outputs=2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (4, 3)
+    assert a.flatten().shape == (2, 3)
+    assert nd.tile(a, reps=(2, 2)).shape == (4, 6)
+
+
+def test_reductions():
+    a = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    assert a.sum().asscalar() == 10
+    np.testing.assert_allclose(a.mean(axis=0).asnumpy(), [2, 3])
+    assert a.max().asscalar() == 4
+    assert a.min(axis=1).shape == (2,)
+    np.testing.assert_allclose(nd.sum(a, axis=0, exclude=True).asnumpy(),
+                               [3, 7])
+
+
+def test_take_embedding():
+    w = mx.nd.array(np.arange(12).reshape(4, 3).astype("float32"))
+    idx = mx.nd.array([1, 3], dtype="int32")
+    out = nd.Embedding(idx, w, input_dim=4, output_dim=3)
+    np.testing.assert_array_equal(out.asnumpy(), [[3, 4, 5], [9, 10, 11]])
+    t = nd.take(w, idx, axis=0)
+    assert t.shape == (2, 3)
+
+
+def test_one_hot_topk_argsort():
+    idx = mx.nd.array([0, 2], dtype="int32")
+    oh = nd.one_hot(idx, depth=3)
+    np.testing.assert_array_equal(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+    a = mx.nd.array([3.0, 1.0, 2.0])
+    top = nd.topk(a, k=2, ret_typ="indices")
+    np.testing.assert_array_equal(top.asnumpy(), [0, 2])
+    srt = nd.sort(a)
+    np.testing.assert_array_equal(srt.asnumpy(), [1, 2, 3])
+
+
+def test_context_placement():
+    a = nd.zeros((2, 2), ctx=mx.cpu(0))
+    assert a.context == mx.cpu(0)
+    b = a.as_in_context(mx.cpu(0))
+    assert b.context.device_type == "cpu"
+    with mx.cpu(0):
+        c = nd.ones((1,))
+    assert c.context.device_type == "cpu"
+
+
+def test_async_semantics():
+    a = nd.ones((64, 64))
+    b = a @ a
+    b.wait_to_read()  # sync point, no error
+    mx.waitall()
+    assert b.asnumpy()[0, 0] == 64
+
+
+def test_scalar_conversions():
+    a = mx.nd.array([3.5])
+    assert float(a) == 3.5
+    assert a.asscalar() == 3.5
+    assert len(nd.zeros((5, 2))) == 5
+    with pytest.raises(Exception):
+        bool(nd.zeros((2,)))
+
+
+def test_numpy_interop():
+    a = mx.nd.array([1.0, 2.0])
+    n = np.asarray(a)
+    np.testing.assert_array_equal(n, [1, 2])
+
+
+def test_where_clip():
+    a = mx.nd.array([-1.0, 0.5, 2.0])
+    np.testing.assert_allclose(a.clip(0, 1).asnumpy(), [0, 0.5, 1])
+    c = nd.where(a > 0, a, nd.zeros((3,)))
+    np.testing.assert_allclose(c.asnumpy(), [0, 0.5, 2])
+
+
+def test_copy_copyto():
+    a = nd.ones((2, 2))
+    b = a.copy()
+    b += 1
+    assert a.asnumpy()[0, 0] == 1
+    c = nd.zeros((2, 2))
+    a.copyto(c)
+    assert c.asnumpy()[0, 0] == 1
