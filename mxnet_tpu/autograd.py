@@ -100,14 +100,17 @@ class Node:
       None                     — constant (no gradient flows)
     """
 
-    __slots__ = ("name", "vjp_fn", "parents", "out_avals", "saved")
+    __slots__ = ("name", "vjp_fn", "parents", "out_avals", "saved", "multi")
 
-    def __init__(self, name, vjp_fn, parents, out_avals):
+    def __init__(self, name, vjp_fn, parents, out_avals, multi=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.parents = parents
         self.out_avals = out_avals  # [(shape, dtype)] per output
         self.saved = None  # set by Function nodes needing extra state
+        # whether the primal returned a tuple (vjp cotangent structure
+        # must match exactly, even for 1-element tuples)
+        self.multi = len(out_avals) > 1 if multi is None else multi
 
     def release(self):
         self.vjp_fn = None
@@ -128,10 +131,10 @@ def is_tracked(arr) -> bool:
     return arr._node is not None or arr._grad_req != "null"
 
 
-def record_node(name, vjp_fn, input_arrays, output_arrays):
+def record_node(name, vjp_fn, input_arrays, output_arrays, multi=None):
     parents = tuple(tape_entry(a) for a in input_arrays)
     out_avals = tuple((o.shape, o.dtype) for o in output_arrays)
-    node = Node(name, vjp_fn, parents, out_avals)
+    node = Node(name, vjp_fn, parents, out_avals, multi=multi)
     for i, o in enumerate(output_arrays):
         o._node = ("node", node, i)
     return node
@@ -215,7 +218,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                 "tape already consumed; pass retain_graph=True to backward() "
                 "to keep it (parity: MXNet frees the graph after backward)"
             )
-        in_cts = node.vjp_fn(tuple(outs) if len(outs) > 1 else outs[0])
+        in_cts = node.vjp_fn(tuple(outs) if node.multi else outs[0])
         for parent, ct in zip(node.parents, in_cts):
             if parent is None or ct is None:
                 continue

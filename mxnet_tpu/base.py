@@ -86,8 +86,7 @@ class Registry:
             return obj
 
         if callable(name) and not isinstance(name, str):
-            obj, name = name, None
-            return _do(obj)
+            return _do(name, None)
         return _do
 
     def get(self, name):

@@ -1,0 +1,33 @@
+"""Gluon: the user-facing imperative/hybrid model API.
+
+Reference parity: python/mxnet/gluon/ — Block/HybridBlock, Parameter,
+Trainer, nn/rnn layer zoos, loss, data, model_zoo, contrib.estimator.
+"""
+from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
+from .parameter import (Constant, Parameter, ParameterDict,  # noqa: F401
+                        DeferredInitializationError)
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+
+
+def __getattr__(name):
+    import importlib
+    lazy = {
+        "rnn": "mxnet_tpu.gluon.rnn",
+        "data": "mxnet_tpu.gluon.data",
+        "model_zoo": "mxnet_tpu.gluon.model_zoo",
+        "contrib": "mxnet_tpu.gluon.contrib",
+        "Trainer": ("mxnet_tpu.gluon.trainer", "Trainer"),
+        "metric": "mxnet_tpu.metric",
+        "utils": "mxnet_tpu.gluon.utils",
+    }
+    if name in lazy:
+        spec = lazy[name]
+        if isinstance(spec, tuple):
+            mod = importlib.import_module(spec[0])
+            obj = getattr(mod, spec[1])
+        else:
+            obj = importlib.import_module(spec)
+        globals()[name] = obj
+        return obj
+    raise AttributeError(f"module 'mxnet_tpu.gluon' has no attribute {name!r}")

@@ -1,0 +1,518 @@
+"""Block / HybridBlock: the user-facing model composition API.
+
+Reference parity: python/mxnet/gluon/block.py — Block (child registry,
+collect_params, save/load_parameters), HybridBlock (hybridize() tracing into
+CachedOp, export), SymbolBlock (de-scoped, see below).
+
+TPU-native design (SURVEY.md §7.1): the reference's CachedOp traces the
+forward into an nnvm graph executed by a bulked engine; here `hybridize()`
+traces the SAME Python `forward` into one XLA computation via `jax.jit`:
+
+    * the whole forward (all ops, all children) compiles into a single
+      fused program — the TPU analog of CachedOp's static_alloc/bulking;
+    * parameters enter as traced arguments (not baked constants), so one
+      compiled program serves every step;
+    * mutable layer state (BatchNorm running stats) is threaded out of the
+      traced function as auxiliary outputs and written back eagerly — the
+      functional-purity equivalent of the reference's mutable-var engine
+      writes (FMutateInputs);
+    * RNG (dropout) enters as a per-call key argument folded through
+      `rng.key_scope`, so repeated calls draw fresh noise exactly like the
+      reference's engine-managed Philox streams;
+    * under `autograd.record()`, the traced function becomes ONE tape node
+      via `jax.vjp` over (params + inputs) — backward is the XLA-compiled
+      cotangent program.
+
+SymbolBlock / nnvm-JSON import is de-scoped: there is no nnvm IR here. Its
+role (load an exported model) is covered by `HybridBlock.export`/`imports`
+over StableHLO + params (see export()).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .. import autograd, initializer as _initmod, rng as _rng
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .parameter import (Constant, DeferredInitializationError, Parameter,
+                        ParameterDict)
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _TraceChannel(threading.local):
+    """Side channel for mutable layer state inside a pure trace.
+
+    While a HybridBlock trace is active, layers that would mutate state
+    eagerly (BatchNorm running stats) instead `push(param, new_value)`;
+    the tracer returns these as extra outputs and writes them back after
+    the compiled call. Mirrors the reference engine's mutable_vars."""
+
+    def __init__(self):
+        self.stack = []
+
+    @property
+    def active(self):
+        return bool(self.stack)
+
+    def push_frame(self):
+        self.stack.append([])
+
+    def pop_frame(self):
+        return self.stack.pop()
+
+    def push(self, param, new_data):
+        self.stack[-1].append((param, new_data))
+
+
+_trace_channel = _TraceChannel()
+
+
+def is_tracing() -> bool:
+    return _trace_channel.active
+
+
+def push_state_update(param, new_data):
+    """Called by layers with mutable state during a hybrid trace."""
+    _trace_channel.push(param, new_data)
+
+
+def _flatten_args(args):
+    """Split a (nested) argument structure into NDArray leaves + a rebuild
+    closure. Supports NDArrays, lists/tuples of them, and arbitrary
+    non-array leaves passed through as static."""
+    leaves = []
+
+    def go(x):
+        if isinstance(x, NDArray):
+            leaves.append(x)
+            return ("arr", len(leaves) - 1)
+        if isinstance(x, (list, tuple)):
+            return ("seq", type(x) is list, tuple(go(v) for v in x))
+        return ("static", x)
+
+    spec = tuple(go(a) for a in args)
+
+    def rebuild(spec_item, arrs):
+        kind = spec_item[0]
+        if kind == "arr":
+            return arrs[spec_item[1]]
+        if kind == "seq":
+            _, is_list, items = spec_item
+            seq = [rebuild(i, arrs) for i in items]
+            return seq if is_list else tuple(seq)
+        return spec_item[1]
+
+    def rebuild_all(arrs):
+        return tuple(rebuild(s, arrs) for s in spec)
+
+    return leaves, spec, rebuild_all
+
+
+def _sig_of(spec, leaves, training):
+    def sig(spec_item):
+        kind = spec_item[0]
+        if kind == "arr":
+            a = leaves[spec_item[1]]
+            return ("arr", a.shape, str(a.dtype))
+        if kind == "seq":
+            return ("seq", spec_item[1], tuple(sig(i) for i in spec_item[2]))
+        v = spec_item[1]
+        try:
+            hash(v)
+        except TypeError:
+            v = repr(v)
+        return ("static", v)
+
+    return (training,) + tuple(sig(s) for s in spec)
+
+
+class Block:
+    """Base class for all layers and models (parity: gluon.Block)."""
+
+    def __init__(self, prefix=None, params=None):
+        # v1 compat args accepted and ignored (v2 dropped prefix/params)
+        self.__dict__["_children"] = {}
+        self.__dict__["_reg_params"] = {}
+        self.__dict__["_forward_hooks"] = []
+        self.__dict__["_forward_pre_hooks"] = []
+        self.__dict__["_dtype_policy"] = None
+
+    # -- registration ------------------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Parameter):
+            self._reg_params[name] = value
+            if value._name in ("weight", "const") or value._name == name:
+                value._name = name
+        elif isinstance(value, Block):
+            self._children[name] = value
+        else:
+            existing = self._children.pop(name, None) or \
+                self._reg_params.pop(name, None)
+            del existing
+        object.__setattr__(self, name, value)
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+        return block
+
+    def register_parameter(self, name, param):
+        self._reg_params[name] = param
+        object.__setattr__(self, name, param)
+        return param
+
+    @property
+    def params(self):
+        """This block's OWN parameters (parity: v2 Block.params)."""
+        return dict(self._reg_params)
+
+    def collect_params(self, select=None) -> ParameterDict:
+        """All parameters in the tree keyed by structure path (parity:
+        collect_params; select is a regex over names as in the reference)."""
+        import re
+        out = ParameterDict()
+
+        def walk(block, path):
+            for name, p in block._reg_params.items():
+                full = ".".join(path + [name]) if path else name
+                p._structure_name = full
+                out[full] = p
+            for cname, child in block._children.items():
+                walk(child, path + [cname])
+
+        walk(self, [])
+        if select is not None:
+            pat = re.compile(select)
+            out = ParameterDict((k, v) for k, v in out.items() if pat.search(k))
+        return out
+
+    # -- lifecycle ---------------------------------------------------------
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = _initmod.Uniform()
+        self.collect_params().initialize(init=init, ctx=ctx,
+                                         force_reinit=force_reinit)
+
+    def cast(self, dtype):
+        for p in self.collect_params().values():
+            p.cast(dtype)
+        self._dtype_policy = dtype
+        for child in self._children.values():
+            child._dtype_policy = dtype
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    def reset_ctx(self, ctx):
+        self.collect_params().reset_ctx(ctx)
+
+    # -- persistence -------------------------------------------------------
+    def save_parameters(self, filename, deduplicate=False):
+        from ..serialization import save_parameter_dict
+        save_parameter_dict(filename, self.collect_params())
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        from ..serialization import load_parameter_dict
+        load_parameter_dict(filename, self.collect_params(),
+                            allow_missing=allow_missing,
+                            ignore_extra=ignore_extra, cast_dtype=cast_dtype)
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_hook(self, hook):
+        return _HookHandle(self._forward_hooks, hook)
+
+    def register_forward_pre_hook(self, hook):
+        return _HookHandle(self._forward_pre_hooks, hook)
+
+    # -- call --------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        try:
+            out = self.forward(*args, **kwargs)
+        except DeferredInitializationError:
+            self._finish_deferred(*args, **kwargs)
+            out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def _finish_deferred(self, *args, **kwargs):
+        self.infer_shape(*args, **kwargs)
+        for p in self._reg_params.values():
+            p._finish_deferred_init()
+
+    def infer_shape(self, *args, **kwargs):
+        """Fill deferred parameter shapes from input shapes. Layers with
+        deferred params override this (parity: the reference's deferred-init
+        shape inference pass through hybrid_forward)."""
+        raise MXNetError(
+            f"{type(self).__name__} has deferred-shape parameters but does "
+            "not implement infer_shape()")
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- introspection -----------------------------------------------------
+    def summary(self, *inputs):
+        """Print a per-layer summary table (parity: Block.summary)."""
+        rows = []
+
+        def hook_factory(name, block):
+            def hook(blk, args, out):
+                o = out[0] if isinstance(out, (tuple, list)) else out
+                nparams = sum(
+                    int(_np.prod(p.shape)) for p in blk._reg_params.values()
+                    if p._shape_is_known)
+                rows.append((name, type(blk).__name__,
+                             getattr(o, "shape", None), nparams))
+            return hook
+
+        handles = []
+
+        def walk(block, path):
+            handles.append(block.register_forward_hook(
+                hook_factory(".".join(path) or "(root)", block)))
+            for cname, child in block._children.items():
+                walk(child, path + [cname])
+
+        walk(self, [])
+        try:
+            self(*inputs)
+        finally:
+            for h in handles:
+                h.detach()
+        header = f"{'Layer':<40}{'Type':<20}{'Output':<24}{'Params':<12}"
+        lines = [header, "-" * len(header)]
+        total = 0
+        for name, typ, shape, nparams in rows:
+            total += nparams
+            lines.append(f"{name:<40}{typ:<20}{str(shape):<24}{nparams:<12}")
+        lines.append("-" * len(header))
+        lines.append(f"Total params: {total}")
+        print("\n".join(lines))
+
+    def __repr__(self):
+        lines = [type(self).__name__ + "("]
+        for name, child in self._children.items():
+            crepr = repr(child).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {crepr}")
+        lines.append(")")
+        return "\n".join(lines)
+
+
+class _HookHandle:
+    def __init__(self, hook_list, hook):
+        self._list = hook_list
+        self._hook = hook
+        hook_list.append(hook)
+
+    def detach(self):
+        if self._hook in self._list:
+            self._list.remove(self._hook)
+
+
+class HybridBlock(Block):
+    """Block whose forward can be traced into one XLA computation.
+
+    hybridize() is the reference's `HybridBlock.hybridize()` → CachedOp;
+    here it switches __call__ to a cached jit path (see module docstring).
+    """
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self.__dict__["_active"] = False
+        self.__dict__["_jit_cache"] = {}
+        self.__dict__["_hybrid_config"] = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  inline_limit=None, backend=None, **kwargs):
+        """static_alloc/static_shape accepted for parity: XLA always plans
+        memory statically, so they are implied. backend= (optimize_for) has
+        no meaning — XLA is the only backend."""
+        self._active = active
+        self._jit_cache = {}
+        self.__dict__["_hybrid_params"] = None  # re-snapshot on next call
+        self._hybrid_config = dict(static_alloc=static_alloc,
+                                   static_shape=static_shape, **kwargs)
+        for child in self._children.values():
+            if isinstance(child, HybridBlock):
+                # children reached through a hybridized parent trace inline;
+                # mark them so direct calls also jit (reference semantics)
+                child.hybridize(active, static_alloc, static_shape)
+        return self
+
+    def optimize_for(self, x, *args, backend=None, **kwargs):
+        self.hybridize()
+        return self(x, *args)
+
+    def __call__(self, *args, **kwargs):
+        if not self._active or _trace_channel.active:
+            # not hybridized, or already inside an enclosing trace: run the
+            # plain Python forward (inlining into the outer trace)
+            return super().__call__(*args, **kwargs)
+        return self._call_cached(*args, **kwargs)
+
+    # -- the CachedOp equivalent ------------------------------------------
+    def _call_cached(self, *args, **kwargs):
+        if kwargs:
+            # kwargs are rare on hybrid paths; fall back to eager semantics
+            return super().__call__(*args, **kwargs)
+        # snapshot the parameter list once per hybridize() — collect_params
+        # walks the whole tree and is too slow for the per-step hot path
+        params = self.__dict__.get("_hybrid_params")
+        if params is None:
+            params = self.collect_params()
+            self.__dict__["_hybrid_params"] = params
+        try:
+            param_arrays = [p.data() for p in params.values()]
+        except (DeferredInitializationError, MXNetError):
+            # first call materializes deferred shapes via the eager path;
+            # new params may appear, so drop the snapshot
+            self.__dict__["_hybrid_params"] = None
+            return super().__call__(*args)
+
+        leaves, spec, rebuild_all = _flatten_args(args)
+        training = autograd.is_training()
+        sig = _sig_of(spec, leaves, training)
+        entry = self._jit_cache.get(sig)
+        if entry is None:
+            entry = self._build_cache_entry(
+                params, spec, rebuild_all, len(param_arrays), training)
+            self._jit_cache[sig] = entry
+        jitted, meta = entry
+
+        key = _rng.next_key()
+        n_params = len(param_arrays)
+
+        def closed(*datas):
+            return jitted(key, datas)
+
+        from ..ops.registry import apply_op
+        all_inputs = param_arrays + leaves
+        outs = apply_op(f"CachedOp({type(self).__name__})", closed, all_inputs)
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        n_real = meta["n_real_outputs"]
+        real, aux = outs[:n_real], outs[n_real:]
+        # write mutable state (BN stats) back into their parameters
+        for (p, _), new in zip(meta["state_updates"], aux):
+            p._data._rebind(new._data)
+        return meta["rebuild_out"](list(real))
+
+    def _build_cache_entry(self, params, spec, rebuild_all, n_params,
+                           training):
+        param_list = list(params.values())
+        meta = {}
+
+        def raw(rng_key, datas):
+            param_datas = datas[:n_params]
+            input_datas = datas[n_params:]
+            saved = [p._data for p in param_list]
+            _trace_channel.push_frame()
+            try:
+                for p, d in zip(param_list, param_datas):
+                    tracer_arr = NDArray(d)
+                    tracer_arr._grad_req = "null"
+                    p._data = tracer_arr
+                arr_args = [NDArray(d) for d in input_datas]
+                rebuilt = rebuild_all(arr_args)
+                with autograd.pause(train_mode=training), \
+                        _rng.key_scope(rng_key):
+                    out = self.forward(*rebuilt)
+            finally:
+                updates = _trace_channel.pop_frame()
+                for p, d in zip(param_list, saved):
+                    p._data = d
+            out_leaves, out_spec, rebuild_out = _flatten_args(
+                out if isinstance(out, tuple) else (out,))
+            single = not isinstance(out, tuple)
+            meta["n_real_outputs"] = len(out_leaves)
+            meta["state_updates"] = updates
+
+            def _rebuild(arrs):
+                r = rebuild_out(arrs)
+                return r[0] if single else r
+
+            meta["rebuild_out"] = _rebuild
+            out_datas = [a._data for a in out_leaves]
+            aux_datas = [jnp.asarray(u) if not isinstance(u, jax.Array)
+                         else u for _, u in updates]
+            return tuple(out_datas) + tuple(aux_datas)
+
+        jitted = jax.jit(raw)
+        return jitted, meta
+
+    def infer_shape(self, *args, **kwargs):
+        raise MXNetError(
+            f"{type(self).__name__} has deferred-shape parameters but does "
+            "not implement infer_shape()")
+
+    # -- export ------------------------------------------------------------
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Export compiled model: StableHLO text of the traced forward +
+        parameters (parity: HybridBlock.export → symbol.json + .params;
+        the nnvm JSON is replaced by StableHLO, XLA's stable IR)."""
+        params = self.collect_params()
+        param_arrays = [p.data() for p in params.values()]
+        # export requires a cached trace: users call net(x) once first,
+        # matching the reference's "forward at least once" requirement
+        if not self._jit_cache:
+            raise MXNetError(
+                "export requires a traced forward: hybridize() and call the "
+                "block once before export() (reference semantics)")
+        sig, (jitted, meta) = next(iter(self._jit_cache.items()))
+        # reconstruct example abstract inputs from the signature
+        def avals_from_sig(s):
+            out = []
+            def go(item):
+                if item[0] == "arr":
+                    out.append(jax.ShapeDtypeStruct(item[1], item[2]))
+                elif item[0] == "seq":
+                    for i in item[2]:
+                        go(i)
+            for item in s[1:]:
+                go(item)
+            return out
+        in_avals = avals_from_sig(sig)
+        key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        datas = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                      for a in param_arrays) + tuple(in_avals)
+        lowered = jitted.lower(key_aval, datas)
+        hlo_path = f"{path}-symbol.stablehlo"
+        with open(hlo_path, "w") as f:
+            f.write(lowered.as_text())
+        from ..serialization import save_parameter_dict
+        params_path = f"{path}-{epoch:04d}.params"
+        save_parameter_dict(params_path, params)
+        return hlo_path, params_path
+
+
+class SymbolBlock(Block):
+    """Parity stub: the reference's SymbolBlock wraps an nnvm-JSON graph.
+    There is no nnvm IR here; exported models are StableHLO + params (see
+    HybridBlock.export). Importing legacy MXNet JSON graphs is de-scoped
+    (SURVEY.md §7.3.5)."""
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        raise MXNetError(
+            "SymbolBlock.imports (legacy nnvm JSON) is not supported; "
+            "rebuild the model in code and load_parameters(), or use "
+            "HybridBlock.export's StableHLO output with jax2tf/serving "
+            "tooling")

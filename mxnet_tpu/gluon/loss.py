@@ -1,0 +1,348 @@
+"""Loss blocks.
+
+Reference parity: python/mxnet/gluon/loss.py — Loss base (weight,
+batch_axis, sample-weight broadcasting), L2Loss, L1Loss,
+SigmoidBinaryCrossEntropyLoss, SoftmaxCrossEntropyLoss, KLDivLoss, CTCLoss,
+HuberLoss, HingeLoss, SquaredHingeLoss, LogisticLoss, TripletLoss,
+PoissonNLLLoss, CosineEmbeddingLoss. Per-sample losses (mean over
+non-batch axes), exactly the reference's reduction convention.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ops import math as _m, nn as _opnn, tensor as _t
+from ..ops.registry import op
+from .block import HybridBlock
+
+__all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
+           "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
+           "KLDivLoss", "CTCLoss", "HuberLoss", "HingeLoss",
+           "SquaredHingeLoss", "LogisticLoss", "TripletLoss",
+           "PoissonNLLLoss", "CosineEmbeddingLoss"]
+
+
+def _apply_weighting(loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None and weight != 1.0:
+        loss = loss * weight
+    return loss
+
+
+def _mean_nonbatch(loss, batch_axis=0):
+    axes = tuple(i for i in range(loss.ndim) if i != batch_axis)
+    return _m.mean(loss, axis=axes) if axes else loss
+
+
+class Loss(HybridBlock):
+    """Base loss (parity: gluon.loss.Loss)."""
+
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(**kwargs)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(batch_axis={self._batch_axis}, "
+                f"w={self._weight})")
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = label.reshape(pred.shape)
+        loss = _m.square(label - pred)
+        loss = _apply_weighting(loss, self._weight / 2, sample_weight)
+        return _mean_nonbatch(loss, self._batch_axis)
+
+
+class L1Loss(Loss):
+    def forward(self, pred, label, sample_weight=None):
+        label = label.reshape(pred.shape)
+        loss = _m.abs(label - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _mean_nonbatch(loss, self._batch_axis)
+
+
+@op("sigmoid_bce", register=False)
+def _sigmoid_bce(pred, label, pos_weight=None):
+    # numerically stable weighted BCE-with-logits (parity: reference):
+    #   l = (1-z)·x + w·softplus(-x),  w = 1 + (pos_weight-1)·z
+    # softplus(-x) computed stably as relu(-x) + log1p(exp(-|x|))
+    softplus_neg = jnp.maximum(-pred, 0) + jnp.log1p(jnp.exp(-jnp.abs(pred)))
+    base = (1.0 - label) * pred
+    if pos_weight is None:
+        return base + softplus_neg
+    w = 1.0 + (pos_weight - 1.0) * label
+    return base + w * softplus_neg
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid=False, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def forward(self, pred, label, sample_weight=None, pos_weight=None):
+        label = label.reshape(pred.shape)
+        if not self._from_sigmoid:
+            loss = _sigmoid_bce(pred, label, pos_weight=pos_weight)
+        elif pos_weight is not None:
+            eps = 1e-12
+            loss = -(pos_weight * label * _m.log(pred + eps) +
+                     (1.0 - label) * _m.log(1.0 - pred + eps))
+        else:
+            eps = 1e-12
+            loss = -(label * _m.log(pred + eps) +
+                     (1.0 - label) * _m.log(1.0 - pred + eps))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _mean_nonbatch(loss, self._batch_axis)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+@op("softmax_ce_loss", register=False)
+def _softmax_ce(pred, label, axis, sparse, from_logits):
+    import jax
+    if not from_logits:
+        pred = jax.nn.log_softmax(pred, axis=axis)
+    if sparse:
+        lbl = jnp.asarray(label, jnp.int32)
+        loss = -jnp.take_along_axis(pred, lbl[..., None] if axis == -1
+                                    else jnp.expand_dims(lbl, axis), axis=axis)
+        return jnp.squeeze(loss, axis)
+    return -jnp.sum(pred * label, axis=axis)
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """Parity: gluon.loss.SoftmaxCrossEntropyLoss (sparse_label, axis,
+    from_logits)."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse = sparse_label
+        self._from_logits = from_logits
+
+    def forward(self, pred, label, sample_weight=None):
+        loss = _softmax_ce(pred, label, self._axis, self._sparse,
+                           self._from_logits)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _mean_nonbatch(loss, self._batch_axis)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = _opnn.log_softmax(pred, axis=self._axis)
+        loss = label * (_m.log(label + 1e-12) - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _mean_nonbatch(loss, self._batch_axis)
+
+
+@op("ctc_loss_kernel", register=False)
+def _ctc_kernel(pred, label, pred_lengths, label_lengths, blank_first):
+    """CTC forward (log-domain dynamic program over lax.scan).
+
+    Parity: src/operator/nn/ctc_loss.cc (warp-ctc). pred: (T, N, C) log-probs
+    after log_softmax; label: (N, L) int; blank index 0 (blank_first) or C-1."""
+    import jax
+    from jax import lax
+    T, N, C = pred.shape
+    L = label.shape[1]
+    blank = 0 if blank_first else C - 1
+    lbl = jnp.asarray(label, jnp.int32)
+    if not blank_first:
+        pass  # labels index real classes already
+    # extended label sequence: blank l1 blank l2 ... lL blank (len 2L+1)
+    S = 2 * L + 1
+    ext = jnp.full((N, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lbl)
+    neg_inf = jnp.asarray(-1e30, pred.dtype)
+
+    # allow transition s-2 -> s when ext[s] != blank and ext[s] != ext[s-2]
+    can_skip = jnp.concatenate(
+        [jnp.zeros((N, 2), bool),
+         (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])], axis=1)
+
+    def step(alpha, logp_t):
+        # alpha: (N, S) log-prob; logp_t: (N, C)
+        emit = jnp.take_along_axis(logp_t, ext, axis=1)  # (N, S)
+        stay = alpha
+        prev1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], 1)
+        prev2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], 1)
+        prev2 = jnp.where(can_skip, prev2, neg_inf)
+        new = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2) + emit
+        return new, new
+
+    init = jnp.full((N, S), neg_inf)
+    init = init.at[:, 0].set(pred[0, jnp.arange(N), ext[:, 0]])
+    init = init.at[:, 1].set(jnp.where(
+        label_lengths > 0, pred[0, jnp.arange(N), ext[:, 1]], neg_inf))
+    alphas, hist = lax.scan(step, init, pred[1:])
+    hist = jnp.concatenate([init[None], hist], axis=0)  # (T, N, S)
+    # gather alpha at t = pred_length-1, s = 2*label_length and 2*label_length-1
+    t_idx = jnp.asarray(pred_lengths, jnp.int32) - 1
+    end = 2 * jnp.asarray(label_lengths, jnp.int32)
+    a_end = hist[t_idx, jnp.arange(N), end]
+    a_end1 = hist[t_idx, jnp.arange(N), jnp.maximum(end - 1, 0)]
+    ll = jnp.logaddexp(a_end, a_end1)
+    return -ll
+
+
+class CTCLoss(Loss):
+    """Connectionist temporal classification (parity: gluon.loss.CTCLoss;
+    layout TNC/NTC, blank at 0 ('first') or C-1 ('last'))."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None,
+                 blank_label="first", **kwargs):
+        super().__init__(weight, 0, **kwargs)
+        if layout not in ("NTC", "TNC"):
+            raise MXNetError(f"bad CTC layout {layout}")
+        self._layout = layout
+        self._label_layout = label_layout
+        self._blank_first = blank_label == "first"
+
+    def forward(self, pred, label, pred_lengths=None, label_lengths=None,
+                sample_weight=None):
+        if self._layout == "NTC":
+            pred = pred.transpose((1, 0, 2))
+        if self._label_layout == "TN":
+            label = label.transpose((1, 0))
+        T, N = pred.shape[0], pred.shape[1]
+        logp = _opnn.log_softmax(pred, axis=-1)
+        if pred_lengths is None:
+            import numpy as _np
+            from ..ndarray.ndarray import NDArray
+            pred_lengths = NDArray(jnp.full((N,), T, jnp.int32))
+        if label_lengths is None:
+            # labels padded with values < 0 are ignored (reference: -1 pad)
+            valid = label >= 0
+            label_lengths = valid.sum(axis=-1)
+            label = _m.where(valid, label, _t.zeros_like(label))
+        loss = _ctc_kernel(logp, label, pred_lengths, label_lengths,
+                           self._blank_first)
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def forward(self, pred, label, sample_weight=None):
+        label = label.reshape(pred.shape)
+        err = _m.abs(label - pred)
+        loss = _m.where(err > self._rho,
+                        err - self._rho / 2,
+                        (0.5 / self._rho) * _m.square(err))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _mean_nonbatch(loss, self._batch_axis)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = label.reshape(pred.shape)
+        loss = _m.clip(self._margin - pred * label, 0, None)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _mean_nonbatch(loss, self._batch_axis)
+
+
+class SquaredHingeLoss(Loss):
+    def __init__(self, margin=1, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = label.reshape(pred.shape)
+        loss = _m.square(_m.clip(self._margin - pred * label, 0, None))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _mean_nonbatch(loss, self._batch_axis)
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, label_format="signed",
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._label_format = label_format
+
+    def forward(self, pred, label, sample_weight=None):
+        label = label.reshape(pred.shape)
+        if self._label_format == "signed":
+            label = (label + 1.0) / 2.0
+        loss = _sigmoid_bce(pred, label)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _mean_nonbatch(loss, self._batch_axis)
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, positive, negative, sample_weight=None):
+        pos = _m.sum(_m.square(pred - positive),
+                     axis=tuple(range(1, pred.ndim)))
+        neg = _m.sum(_m.square(pred - negative),
+                     axis=tuple(range(1, pred.ndim)))
+        loss = _m.clip(pos - neg + self._margin, 0, None)
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, from_logits=True, compute_full=False, weight=1.0,
+                 batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def forward(self, pred, label, sample_weight=None, epsilon=1e-08):
+        label = label.reshape(pred.shape)
+        if self._from_logits:
+            loss = _m.exp(pred) - label * pred
+        else:
+            loss = pred - label * _m.log(pred + epsilon)
+        if self._compute_full:
+            stirling = label * _m.log(label + 1e-12) - label + \
+                0.5 * _m.log(2 * 3.141592653589793 * (label + 1e-12))
+            loss = loss + _m.where(label > 1, stirling,
+                                   _t.zeros_like(label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return _m.mean(loss)
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, input1, input2, label, sample_weight=None):
+        def cos(a, b):
+            num = _m.sum(a * b, axis=-1)
+            den = _m.sqrt(_m.sum(a * a, axis=-1)) * \
+                _m.sqrt(_m.sum(b * b, axis=-1))
+            return num / (den + 1e-12)
+
+        sim = cos(input1, input2)
+        label = label.reshape(sim.shape)
+        loss = _m.where(label == 1, 1.0 - sim,
+                        _m.clip(sim - self._margin, 0, None))
+        return _apply_weighting(loss, self._weight, sample_weight)

@@ -1,0 +1,87 @@
+"""Activation layers.
+
+Reference parity: python/mxnet/gluon/nn/activations.py — Activation,
+LeakyReLU, PReLU, ELU, SELU, Swish, GELU, SiLU (kernels in ops.nn; XLA
+fuses them into surrounding ops, replacing the reference's mshadow_op
+functor zoo).
+"""
+from __future__ import annotations
+
+from ...ops import nn as _opnn
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "Swish",
+           "SiLU", "GELU"]
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation, **kwargs):
+        super().__init__(**kwargs)
+        self._act_type = activation
+
+    def forward(self, x):
+        return _opnn.Activation(x, act_type=self._act_type)
+
+    def __repr__(self):
+        return f"Activation({self._act_type})"
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha=0.01, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return _opnn.LeakyReLU(x, act_type="leaky", slope=self._alpha)
+
+    def __repr__(self):
+        return f"LeakyReLU({self._alpha})"
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer="zeros", in_channels=1, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = Parameter("alpha", shape=(in_channels,),
+                               init=alpha_initializer)
+
+    def forward(self, x):
+        return _opnn.LeakyReLU(x, self.alpha.data(), act_type="prelu")
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return _opnn.LeakyReLU(x, act_type="elu", slope=self._alpha)
+
+
+class SELU(HybridBlock):
+    def forward(self, x):
+        return _opnn.LeakyReLU(x, act_type="selu")
+
+
+class Swish(HybridBlock):
+    def __init__(self, beta=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._beta = beta
+
+    def forward(self, x):
+        if self._beta == 1.0:
+            return _opnn.silu(x)
+        from ...ops import math as _m
+        return x * _opnn.Activation(x * self._beta, act_type="sigmoid")
+
+
+SiLU = Swish
+
+
+class GELU(HybridBlock):
+    def __init__(self, approximation="none", **kwargs):
+        super().__init__(**kwargs)
+        self._approx = approximation in ("tanh", True)
+
+    def forward(self, x):
+        return _opnn.gelu(x, approximate=self._approx)

@@ -1,0 +1,413 @@
+"""Basic neural-network layers.
+
+Reference parity: python/mxnet/gluon/nn/basic_layers.py — Sequential,
+HybridSequential, Dense, Dropout, Embedding, BatchNorm, InstanceNorm,
+LayerNorm, GroupNorm, Flatten, Lambda, HybridLambda, Identity, Concatenate.
+Kernel bodies are the registered ops in mxnet_tpu.ops.nn (XLA primitives).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ... import initializer as _init
+from ...base import MXNetError
+from ...ops import nn as _opnn, tensor as _opt
+from ..block import Block, HybridBlock, is_tracing, push_state_update
+from ..parameter import Parameter
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
+           "BatchNorm", "InstanceNorm", "LayerNorm", "GroupNorm", "RMSNorm",
+           "Flatten", "Lambda", "HybridLambda", "Identity", "Concatenate",
+           "HybridConcatenate"]
+
+
+class Sequential(Block):
+    """Stack of blocks executed in order (parity: nn.Sequential)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+        return self
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x) if not args else block(x, *args)
+            args = ()
+        return x
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*layers[key])
+            return net
+        return layers[key]
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+    def hybridize(self, active=True, **kwargs):
+        for c in self._children.values():
+            if isinstance(c, HybridBlock):
+                c.hybridize(active, **kwargs)
+
+
+class HybridSequential(HybridBlock):
+    """Hybridizable Sequential (parity: nn.HybridSequential)."""
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+        return self
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x) if not args else block(x, *args)
+            args = ()
+        return x
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*layers[key])
+            return net
+        return layers[key]
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """Fully connected layer (parity: nn.Dense; kernel: FullyConnected op →
+    dot_general on the MXU). weight shape (units, in_units) as in the
+    reference."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._flatten = flatten
+        self._activation = activation
+        self.weight = Parameter("weight", shape=(units, in_units),
+                                dtype=dtype, init=weight_initializer,
+                                allow_deferred_init=True)
+        self.bias = Parameter("bias", shape=(units,), dtype=dtype,
+                              init=bias_initializer,
+                              allow_deferred_init=True) if use_bias else None
+
+    def infer_shape(self, x, *args):
+        in_units = int(_np.prod(x.shape[1:])) if self._flatten \
+            else x.shape[-1]
+        self.weight.shape = (self._units, in_units)
+
+    def forward(self, x):
+        y = _opnn.FullyConnected(
+            x, self.weight.data(),
+            self.bias.data() if self.bias is not None else None,
+            num_hidden=self._units, no_bias=self.bias is None,
+            flatten=self._flatten)
+        if self._activation is not None:
+            y = _opnn.Activation(y, act_type=self._activation)
+        return y
+
+    def __repr__(self):
+        shape = self.weight.shape
+        return (f"Dense({shape[1] if shape[1] else None} -> {shape[0]}, "
+                f"{self._activation or 'linear'})")
+
+
+class Dropout(HybridBlock):
+    """Inverted dropout, active in training mode (parity: nn.Dropout)."""
+
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def forward(self, x):
+        return _opnn.Dropout(x, p=self._rate, axes=self._axes)
+
+    def __repr__(self):
+        return f"Dropout(p = {self._rate}, axes={self._axes})"
+
+
+class Embedding(HybridBlock):
+    """Index → dense vector lookup (parity: nn.Embedding; XLA gather).
+
+    sparse_grad is accepted for API compatibility and ignored: row_sparse
+    gradients are de-scoped on TPU (dense grads; XLA scatter-add in the
+    backward is efficient on HBM)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = Parameter("weight", shape=(input_dim, output_dim),
+                                dtype=dtype, init=weight_initializer)
+
+    def forward(self, x):
+        return _opt.take(self.weight.data(), x, axis=0, mode="clip")
+
+    def __repr__(self):
+        return f"Embedding({self._input_dim} -> {self._output_dim})"
+
+
+class BatchNorm(HybridBlock):
+    """Batch normalization with running stats (parity: nn.BatchNorm).
+
+    The reference kernel mutates moving_mean/moving_var via the engine's
+    mutable vars; here the op is pure — the layer owns the running-stat
+    update, routing it through the hybrid trace side channel when traced
+    (gluon.block.push_state_update)."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        sh = (in_channels,)
+        self.gamma = Parameter("gamma", shape=sh, init=gamma_initializer,
+                               allow_deferred_init=True,
+                               grad_req="write" if scale else "null")
+        self.beta = Parameter("beta", shape=sh, init=beta_initializer,
+                              allow_deferred_init=True,
+                              grad_req="write" if center else "null")
+        self.running_mean = Parameter(
+            "running_mean", shape=sh, init=running_mean_initializer,
+            allow_deferred_init=True, grad_req="null", differentiable=False)
+        self.running_var = Parameter(
+            "running_var", shape=sh, init=running_variance_initializer,
+            allow_deferred_init=True, grad_req="null", differentiable=False)
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            p.shape = (c,)
+
+    def forward(self, x):
+        from ... import autograd
+        training = autograd.is_training() and not self._use_global_stats
+        out = _opnn.BatchNorm(
+            x, self.gamma.data(), self.beta.data(),
+            self.running_mean.data(), self.running_var.data(),
+            eps=self._epsilon, momentum=self._momentum,
+            fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats, axis=self._axis)
+        if isinstance(out, tuple):
+            y, batch_mean, batch_var = out
+            if training:
+                self._update_stats(batch_mean, batch_var)
+            return y
+        return out
+
+    def _update_stats(self, mean, var):
+        m = self._momentum
+        new_mean = self.running_mean.data() * m + mean * (1 - m)
+        new_var = self.running_var.data() * m + var * (1 - m)
+        if is_tracing():
+            push_state_update(self.running_mean, new_mean._data)
+            push_state_update(self.running_var, new_var._data)
+        else:
+            self.running_mean._data._rebind(new_mean._data)
+            self.running_var._data._rebind(new_var._data)
+
+    def __repr__(self):
+        return (f"BatchNorm(axis={self._axis}, eps={self._epsilon}, "
+                f"momentum={self._momentum}, "
+                f"in_channels={self.gamma.shape[0] or None})")
+
+
+class LayerNorm(HybridBlock):
+    """Layer normalization (parity: nn.LayerNorm; XLA fuses the reductions
+    replacing the reference's hand-written fast CUDA kernel)."""
+
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=gamma_initializer,
+                               allow_deferred_init=True,
+                               grad_req="write" if scale else "null")
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=beta_initializer,
+                              allow_deferred_init=True,
+                              grad_req="write" if center else "null")
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis]
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x):
+        return _opnn.LayerNorm(x, self.gamma.data(), self.beta.data(),
+                               axis=self._axis, eps=self._epsilon)
+
+    def __repr__(self):
+        return (f"LayerNorm(axis={self._axis}, eps={self._epsilon}, "
+                f"in_channels={self.gamma.shape[0] or None})")
+
+
+class GroupNorm(HybridBlock):
+    """Group normalization (parity: nn.GroupNorm)."""
+
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=gamma_initializer,
+                               allow_deferred_init=True,
+                               grad_req="write" if scale else "null")
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=beta_initializer,
+                              allow_deferred_init=True,
+                              grad_req="write" if center else "null")
+
+    def infer_shape(self, x, *args):
+        c = x.shape[1]
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x):
+        return _opnn.GroupNorm(x, self.gamma.data(), self.beta.data(),
+                               num_groups=self._num_groups,
+                               eps=self._epsilon)
+
+
+class RMSNorm(HybridBlock):
+    """RMS normalization (TPU-native addition; modern-LLM staple)."""
+
+    def __init__(self, axis=-1, epsilon=1e-6, gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=gamma_initializer,
+                               allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        self.gamma.shape = (x.shape[self._axis],)
+
+    def forward(self, x):
+        return _opnn.rms_norm(x, self.gamma.data(), axis=self._axis,
+                              eps=self._epsilon)
+
+
+class InstanceNorm(HybridBlock):
+    """Instance normalization (parity: nn.InstanceNorm)."""
+
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        if axis != 1:
+            raise MXNetError("InstanceNorm supports axis=1 (NC+) only")
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=gamma_initializer,
+                               allow_deferred_init=True,
+                               grad_req="write" if scale else "null")
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=beta_initializer,
+                              allow_deferred_init=True,
+                              grad_req="write" if center else "null")
+
+    def infer_shape(self, x, *args):
+        c = x.shape[1]
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def forward(self, x):
+        return _opnn.InstanceNorm(x, self.gamma.data(), self.beta.data(),
+                                  eps=self._epsilon)
+
+
+class Flatten(HybridBlock):
+    """Flatten to (batch, -1) (parity: nn.Flatten)."""
+
+    def forward(self, x):
+        return _opt.flatten(x)
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+
+class Lambda(Block):
+    """Wrap a function (or registered-op name) as a Block (parity: nn.Lambda)."""
+
+    def __init__(self, function, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(function, str):
+            from ...ops.registry import get_op
+            function = get_op(function)
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+    def __repr__(self):
+        return f"Lambda({getattr(self._func, '__name__', self._func)})"
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(function, str):
+            from ...ops.registry import get_op
+            function = get_op(function)
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridConcatenate(HybridBlock):
+    """Run children on the same input, concat outputs (parity: contrib
+    HybridConcurrent/Concatenate)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+        return self
+
+    def forward(self, x):
+        outs = [block(x) for block in self._children.values()]
+        return _opt.concat(*outs, dim=self.axis)
+
+
+class Concatenate(HybridConcatenate):
+    pass

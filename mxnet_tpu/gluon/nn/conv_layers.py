@@ -1,0 +1,282 @@
+"""Convolution and pooling layers.
+
+Reference parity: python/mxnet/gluon/nn/conv_layers.py — Conv1D/2D/3D,
+Conv1DTranspose/2D/3D, MaxPool1D/2D/3D, AvgPool1D/2D/3D, GlobalMaxPool*,
+GlobalAvgPool*, ReflectionPad2D. Kernels: lax.conv_general_dilated /
+reduce_window via mxnet_tpu.ops.nn (MXU-native; the cuDNN wrapper layer of
+the reference has no equivalent — XLA owns algorithm selection).
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...ops import nn as _opnn, tensor as _opt
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D",
+           "Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose",
+           "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D",
+           "GlobalMaxPool1D", "GlobalMaxPool2D", "GlobalMaxPool3D",
+           "GlobalAvgPool1D", "GlobalAvgPool2D", "GlobalAvgPool3D",
+           "ReflectionPad2D"]
+
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _Conv(HybridBlock):
+    """Shared conv implementation. Layout is channel-first ('NCW'/'NCHW'/
+    'NCDHW') as in the reference's default; XLA:TPU relayouts internally."""
+
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", dtype="float32", op=None, adj=None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        nd = len(kernel_size)
+        if layout not in ("NCW", "NCHW", "NCDHW")[nd - 1:nd]:
+            raise MXNetError(
+                f"layout {layout!r} not supported: channel-first only "
+                "(TPU XLA applies its own physical tiling; NHWC adds no "
+                "value and is de-scoped)")
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = kernel_size
+        self._strides = strides
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._activation = activation
+        self._op = op
+        self._adj = adj
+        wshape = ((channels, in_channels // groups if in_channels else 0)
+                  + kernel_size) if op is not Deconv else \
+                 ((in_channels if in_channels else 0, channels // groups)
+                  + kernel_size)
+        self.weight = Parameter("weight", shape=wshape, dtype=dtype,
+                                init=weight_initializer,
+                                allow_deferred_init=True)
+        self.bias = Parameter("bias", shape=(channels,), dtype=dtype,
+                              init=bias_initializer,
+                              allow_deferred_init=True) if use_bias else None
+
+    def infer_shape(self, x, *args):
+        c = x.shape[1]
+        if self._op is Deconv:
+            self.weight.shape = (c, self._channels // self._groups) + \
+                self._kernel
+        else:
+            self.weight.shape = (self._channels, c // self._groups) + \
+                self._kernel
+        self._in_channels = c
+
+    def forward(self, x):
+        w = self.weight.data()
+        b = self.bias.data() if self.bias is not None else None
+        if self._op is Deconv:
+            y = _opnn.Deconvolution(
+                x, w, b, kernel=self._kernel, stride=self._strides,
+                dilate=self._dilation, pad=self._padding, adj=self._adj,
+                num_filter=self._channels, num_group=self._groups,
+                no_bias=b is None)
+        else:
+            y = _opnn.Convolution(
+                x, w, b, kernel=self._kernel, stride=self._strides,
+                dilate=self._dilation, pad=self._padding,
+                num_filter=self._channels, num_group=self._groups,
+                no_bias=b is None)
+        if self._activation is not None:
+            y = _opnn.Activation(y, act_type=self._activation)
+        return y
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._in_channels or None} -> "
+                f"{self._channels}, kernel_size={self._kernel}, "
+                f"stride={self._strides}, padding={self._padding})")
+
+
+class Deconv:  # marker for _Conv op selection
+    pass
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 1), _tup(strides, 1),
+                         _tup(padding, 1), _tup(dilation, 1), groups, layout,
+                         **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 2), _tup(strides, 2),
+                         _tup(padding, 2), _tup(dilation, 2), groups, layout,
+                         **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 3), _tup(strides, 3),
+                         _tup(padding, 3), _tup(dilation, 3), groups, layout,
+                         **kwargs)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 **kwargs):
+        super().__init__(channels, _tup(kernel_size, 1), _tup(strides, 1),
+                         _tup(padding, 1), _tup(dilation, 1), groups, layout,
+                         op=Deconv, adj=_tup(output_padding, 1), **kwargs)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 2), _tup(strides, 2),
+                         _tup(padding, 2), _tup(dilation, 2), groups, layout,
+                         op=Deconv, adj=_tup(output_padding, 2), **kwargs)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 3), _tup(strides, 3),
+                         _tup(padding, 3), _tup(dilation, 3), groups, layout,
+                         op=Deconv, adj=_tup(output_padding, 3), **kwargs)
+
+
+class _Pooling(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
+                 pool_type, count_include_pad=True, layout=None, **kwargs):
+        super().__init__(**kwargs)
+        self._pool_size = pool_size
+        self._strides = strides if strides is not None else pool_size
+        self._padding = padding
+        self._ceil = ceil_mode
+        self._global = global_pool
+        self._type = pool_type
+        self._count_include_pad = count_include_pad
+
+    def forward(self, x):
+        return _opnn.Pooling(
+            x, kernel=self._pool_size, pool_type=self._type,
+            global_pool=self._global, stride=self._strides,
+            pad=self._padding,
+            pooling_convention="full" if self._ceil else "valid",
+            count_include_pad=self._count_include_pad)
+
+    def __repr__(self):
+        if self._global:
+            return f"{type(self).__name__}"
+        return (f"{type(self).__name__}(size={self._pool_size}, "
+                f"stride={self._strides}, padding={self._padding}, "
+                f"ceil_mode={self._ceil})")
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, ceil_mode=False,
+                 layout="NCW", **kwargs):
+        super().__init__(_tup(pool_size, 1),
+                         _tup(strides, 1) if strides is not None else None,
+                         _tup(padding, 1), ceil_mode, False, "max", **kwargs)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 ceil_mode=False, layout="NCHW", **kwargs):
+        super().__init__(_tup(pool_size, 2),
+                         _tup(strides, 2) if strides is not None else None,
+                         _tup(padding, 2), ceil_mode, False, "max", **kwargs)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 ceil_mode=False, layout="NCDHW", **kwargs):
+        super().__init__(_tup(pool_size, 3),
+                         _tup(strides, 3) if strides is not None else None,
+                         _tup(padding, 3), ceil_mode, False, "max", **kwargs)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, ceil_mode=False,
+                 count_include_pad=True, layout="NCW", **kwargs):
+        super().__init__(_tup(pool_size, 1),
+                         _tup(strides, 1) if strides is not None else None,
+                         _tup(padding, 1), ceil_mode, False, "avg",
+                         count_include_pad, **kwargs)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 ceil_mode=False, count_include_pad=True, layout="NCHW",
+                 **kwargs):
+        super().__init__(_tup(pool_size, 2),
+                         _tup(strides, 2) if strides is not None else None,
+                         _tup(padding, 2), ceil_mode, False, "avg",
+                         count_include_pad, **kwargs)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 ceil_mode=False, count_include_pad=True, layout="NCDHW",
+                 **kwargs):
+        super().__init__(_tup(pool_size, 3),
+                         _tup(strides, 3) if strides is not None else None,
+                         _tup(padding, 3), ceil_mode, False, "avg",
+                         count_include_pad, **kwargs)
+
+
+class GlobalMaxPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__((1,), None, (0,), False, True, "max", **kwargs)
+
+
+class GlobalMaxPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), None, (0, 0), False, True, "max", **kwargs)
+
+
+class GlobalMaxPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "max",
+                         **kwargs)
+
+
+class GlobalAvgPool1D(_Pooling):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__((1,), None, (0,), False, True, "avg", **kwargs)
+
+
+class GlobalAvgPool2D(_Pooling):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__((1, 1), None, (0, 0), False, True, "avg", **kwargs)
+
+
+class GlobalAvgPool3D(_Pooling):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "avg",
+                         **kwargs)
+
+
+class ReflectionPad2D(HybridBlock):
+    """Reflection padding on H/W of NCHW input (parity: nn.ReflectionPad2D)."""
+
+    def __init__(self, padding=0, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(padding, int):
+            padding = (padding,) * 4  # (left, right, top, bottom)
+        self._padding = padding
+
+    def forward(self, x):
+        l, r, t, b = self._padding
+        pw = ((0, 0), (0, 0), (t, b), (l, r))
+        return _opt.pad(x, pad_width=pw, mode="reflect")

@@ -1,0 +1,296 @@
+"""Parameter: a trainable weight with deferred shape initialization.
+
+Reference parity: python/mxnet/gluon/parameter.py — Parameter (deferred
+shape init completed on first forward, grad_req write/add/null, lr_mult /
+wd_mult, per-context data replication) and Constant.
+
+TPU-native differences by design:
+  * One logical array per parameter. The reference replicates a parameter
+    per GPU context (`list_data()` over ctx list) and all-reduces gradients
+    in the Trainer; here multi-device is expressed with `jax.sharding` — a
+    parameter carries an optional `sharding` (a PartitionSpec over the
+    active mesh, see mxnet_tpu.parallel) and XLA lays it out across
+    devices. `list_data()` therefore returns a one-element list.
+  * Gradients live on `param.grad()` NDArrays exactly as in the reference,
+    written by the autograd tape per `grad_req`.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax.numpy as jnp
+
+from .. import initializer as _init
+from ..base import MXNetError
+from ..device import current_device
+from ..ndarray.ndarray import NDArray
+
+
+class DeferredInitializationError(MXNetError):
+    """Raised by .data() before a shape-deferred parameter is materialized."""
+
+
+class Parameter:
+    def __init__(self, name="weight", grad_req="write", shape=None,
+                 dtype="float32", lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True,
+                 stype="default", grad_stype="default"):
+        self._name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._grad_req = grad_req if differentiable else "null"
+        self._differentiable = differentiable
+        if stype != "default" or grad_stype != "default":
+            # sparse storage is de-scoped on TPU (SURVEY.md §7.3.5); dense
+            # embeddings + XLA gather/scatter replace row_sparse params
+            raise MXNetError(
+                "sparse parameter storage (stype/grad_stype != 'default') is "
+                "not supported on TPU; use dense parameters")
+        self._data: NDArray | None = None
+        self._deferred_init = None  # (initializer, ctx) awaiting shape
+        self._sharding = None  # PartitionSpec for mesh-sharded params
+        self._structure_name = None  # hierarchical name set by Block
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def name(self):
+        return self._structure_name or self._name
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self._shape}, dtype={self.dtype})"
+
+    # -- shape handling (deferred init) -----------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        unknown_ok = all(
+            s1 in (0, -1, None) or s1 == s2
+            for s1, s2 in zip(self._shape, new_shape))
+        if len(self._shape) != len(new_shape) or not unknown_ok:
+            raise MXNetError(
+                f"cannot reset shape of {self.name} from {self._shape} to "
+                f"{tuple(new_shape)}: only unknown (0) dims may be filled in")
+        self._shape = tuple(new_shape)
+
+    @property
+    def _shape_is_known(self):
+        return self._shape is not None and all(
+            s not in (0, -1, None) and s > 0 for s in self._shape)
+
+    # -- grad_req ----------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise MXNetError(f"invalid grad_req {req!r}")
+        if not self._differentiable:
+            req = "null"
+        self._grad_req = req
+        if self._data is not None:
+            self._data._grad_req = req
+            if req == "null":
+                self._data._grad = None
+            elif self._data._grad is None:
+                self._data.attach_grad(req)
+                self._data._grad_req = req
+
+    # -- initialization ----------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is not None and isinstance(ctx, (list, tuple)):
+            # reference replicates across a ctx list; on TPU placement is a
+            # sharding concern — a list collapses to its first device
+            ctx = ctx[0] if ctx else None
+        # an init chosen for THIS parameter (initialize(init=...) or the
+        # Parameter's own init=) bypasses name-suffix dispatch; only the
+        # global default init is suffix-dispatched (bias→0, gamma→1, …)
+        explicit = _init.get(init) or _init.get(self.init)
+        init = explicit or _init.get(default_init, _init.Uniform())
+        if not self._shape_is_known:
+            if not self.allow_deferred_init:
+                raise MXNetError(
+                    f"cannot initialize {self.name}: shape {self._shape} "
+                    "unknown and allow_deferred_init is False")
+            self._deferred_init = (init, ctx, explicit is not None)
+            return
+        self._materialize(init, ctx, explicit is not None)
+
+    def _materialize(self, init, ctx, explicit=False):
+        desc = _init.InitDesc(self.name)
+        value = init(desc, self._shape, _np.dtype(self.dtype).name
+                     if not isinstance(self.dtype, str) else self.dtype,
+                     force_weight=explicit)
+        arr = NDArray(jnp.asarray(value, dtype=self.dtype), ctx=ctx)
+        self._set_array(arr)
+        self._deferred_init = None
+
+    def _finish_deferred_init(self):
+        if self._deferred_init is None:
+            return
+        if not self._shape_is_known:
+            raise DeferredInitializationError(
+                f"parameter {self.name} shape still unknown: {self._shape}")
+        init, ctx, explicit = self._deferred_init
+        self._materialize(init, ctx, explicit)
+
+    def _set_array(self, arr: NDArray):
+        self._data = arr
+        if self._grad_req != "null":
+            arr.attach_grad(self._grad_req)
+        if self._sharding is not None:
+            self._apply_sharding()
+
+    # -- access ------------------------------------------------------------
+    def data(self, ctx=None):
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"parameter {self.name} deferred; forward once or set "
+                    "its shape to materialize")
+            raise MXNetError(
+                f"parameter {self.name} has not been initialized; call "
+                ".initialize() first")
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None):
+        d = self.data()
+        if self._grad_req == "null":
+            raise MXNetError(
+                f"cannot get gradient of {self.name}: grad_req is 'null'")
+        return d._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init is not None:
+                ctx = self._deferred_init[1]
+                return [ctx or current_device()]
+            raise MXNetError(f"parameter {self.name} not initialized")
+        return [self._data.context]
+
+    def set_data(self, data):
+        if not isinstance(data, NDArray):
+            data = NDArray(jnp.asarray(data, dtype=self.dtype))
+        self.shape = data.shape
+        if self._data is None:
+            self._set_array(data.astype(self.dtype))
+            self._deferred_init = None
+        else:
+            self._data._assign_from(data.astype(self.dtype))
+
+    def zero_grad(self):
+        if self._data is not None and self._data._grad is not None:
+            self._data.zero_grad()
+
+    def reset_ctx(self, ctx):
+        if self._data is not None:
+            self._data = self._data.as_in_context(ctx)
+            if self._grad_req != "null":
+                self._data.attach_grad(self._grad_req)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is not None:
+            arr = self._data.astype(dtype)
+            self._set_array(arr)
+
+    # -- sharding (TPU-native extension; see mxnet_tpu.parallel) -----------
+    @property
+    def sharding(self):
+        return self._sharding
+
+    @sharding.setter
+    def sharding(self, spec):
+        self._sharding = spec
+        if self._data is not None and spec is not None:
+            self._apply_sharding()
+
+    def _apply_sharding(self):
+        from ..parallel import current_mesh
+        import jax
+        mesh = current_mesh()
+        if mesh is None:
+            return
+        s = jax.sharding.NamedSharding(mesh, self._sharding)
+        self._data._data = jax.device_put(self._data._data, s)
+
+    def var(self):
+        raise MXNetError(
+            "Parameter.var (symbol handle) does not exist: the Symbol API is "
+            "replaced by tracing; see HybridBlock.hybridize")
+
+
+class Constant(Parameter):
+    """Non-trainable constant (parity: gluon.Constant)."""
+
+    def __init__(self, value, name="const"):
+        if not isinstance(value, NDArray):
+            value = NDArray(jnp.asarray(value))
+        super().__init__(name=name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, differentiable=False,
+                         init=_init.Constant(0))
+        self._value = value
+        self._set_array(value)
+
+    def initialize(self, *args, **kwargs):
+        pass
+
+
+class ParameterDict(dict):
+    """dict of name->Parameter with batched helpers (parity: the v2
+    `collect_params()` return type; the v1 ParameterDict prefix machinery is
+    subsumed by structure-based naming)."""
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        for p in self.values():
+            p.initialize(init=None, ctx=ctx, default_init=init,
+                         force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def reset_ctx(self, ctx):
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def save(self, filename, strip_prefix=""):
+        from ..serialization import save_parameter_dict
+        save_parameter_dict(filename, self, strip_prefix=strip_prefix)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, cast_dtype=False, dtype_source="current"):
+        from ..serialization import load_parameter_dict
+        load_parameter_dict(filename, self, allow_missing=allow_missing,
+                            ignore_extra=ignore_extra, cast_dtype=cast_dtype)
+
+    def get(self, name, **kwargs):
+        if name in self:
+            return self[name]
+        p = Parameter(name, **kwargs)
+        self[name] = p
+        return p

@@ -1,0 +1,273 @@
+"""Weight initializers.
+
+Reference parity: python/mxnet/initializer.py — Initializer base class with a
+name-aware dispatch (InitDesc), and the standard zoo: Zero, One, Constant,
+Uniform, Normal, Orthogonal, Xavier, MSRAPrelu, Bilinear, LSTMBias, Identity.
+Registered in a dmlc-style registry so `init='xavier'` strings work, as in
+the reference's `@mx.init.register` + alias mechanism.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+from .base import MXNetError, Registry
+
+_REGISTRY = Registry("initializer")
+register = _REGISTRY.register
+create = _REGISTRY.create
+
+
+def get(obj, default=None):
+    """Resolve str | Initializer | None into an Initializer instance."""
+    if obj is None:
+        return default
+    if isinstance(obj, Initializer):
+        return obj
+    if isinstance(obj, str):
+        cls = _REGISTRY.get(obj)
+        return cls()
+    raise MXNetError(f"cannot interpret {obj!r} as an initializer")
+
+
+class InitDesc(str):
+    """Parameter name + attrs passed to initializers (parity: InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    """Base initializer. Subclasses implement `_init_weight(name, shape,
+    dtype) -> numpy array`; dispatch by parameter-name suffix mirrors the
+    reference (`__call__` routes *_bias→zeros, *gamma→ones, …)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, desc, shape, dtype="float32", force_weight=False):
+        """force_weight=True bypasses the name-suffix dispatch — used when
+        this initializer was EXPLICITLY chosen for the parameter (parity:
+        the reference only applies suffix dispatch to the global default
+        init, never to a parameter's own init)."""
+        if force_weight:
+            return self._init_weight(str(desc), shape, dtype)
+        name = str(desc)
+        if name.endswith("bias"):
+            return self._init_bias(name, shape, dtype)
+        if name.endswith("gamma"):
+            return self._init_one(name, shape, dtype)
+        if name.endswith("beta"):
+            return self._init_zero(name, shape, dtype)
+        if name.endswith("running_mean") or name.endswith("moving_mean"):
+            return self._init_zero(name, shape, dtype)
+        if name.endswith("running_var") or name.endswith("moving_var"):
+            return self._init_one(name, shape, dtype)
+        return self._init_weight(name, shape, dtype)
+
+    init_array = __call__
+
+    def _init_zero(self, name, shape, dtype):
+        return _np.zeros(shape, dtype=dtype)
+
+    def _init_one(self, name, shape, dtype):
+        return _np.ones(shape, dtype=dtype)
+
+    def _init_bias(self, name, shape, dtype):
+        return _np.zeros(shape, dtype=dtype)
+
+    def _init_weight(self, name, shape, dtype):
+        raise NotImplementedError
+
+    def _rng(self):
+        from . import rng as _rng
+        import jax
+        # derive a numpy Generator from the framework key stream so
+        # mx.random.seed() controls initialization too
+        key = _rng.next_key()
+        seed = int(jax.random.randint(key, (), 0, 2**31 - 1))
+        return _np.random.default_rng(seed)
+
+    def __repr__(self):
+        kw = ", ".join(f"{k}={v}" for k, v in self._kwargs.items())
+        return f"{type(self).__name__}({kw})"
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+
+@register("zeros", aliases=("zero",))
+class Zero(Initializer):
+    def _init_weight(self, name, shape, dtype):
+        return _np.zeros(shape, dtype=dtype)
+
+
+@register("ones", aliases=("one",))
+class One(Initializer):
+    def _init_weight(self, name, shape, dtype):
+        return _np.ones(shape, dtype=dtype)
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, shape, dtype):
+        return _np.full(shape, self.value, dtype=dtype)
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, shape, dtype):
+        return self._rng().uniform(-self.scale, self.scale, shape).astype(dtype)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, shape, dtype):
+        return (self._rng().standard_normal(shape) * self.sigma).astype(dtype)
+
+
+def _fan_in_out(shape):
+    hw_scale = 1.0
+    if len(shape) < 2:
+        return (shape[0] if shape else 1.0, shape[0] if shape else 1.0)
+    if len(shape) > 2:
+        hw_scale = float(_np.prod(shape[2:]))
+    fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+    return fan_in, fan_out
+
+
+@register("xavier", aliases=("glorot",))
+class Xavier(Initializer):
+    """Parity: mx.init.Xavier(rnd_type, factor_type, magnitude)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, shape, dtype):
+        fan_in, fan_out = _fan_in_out(shape)
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError(f"invalid factor_type {self.factor_type}")
+        scale = math.sqrt(self.magnitude / max(factor, 1e-12))
+        rng = self._rng()
+        if self.rnd_type == "uniform":
+            a = rng.uniform(-scale, scale, shape)
+        elif self.rnd_type == "gaussian":
+            a = rng.standard_normal(shape) * scale
+        else:
+            raise MXNetError(f"invalid rnd_type {self.rnd_type}")
+        return a.astype(dtype)
+
+
+@register("msraprelu")
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        Xavier.__init__(self, "gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, shape, dtype):
+        rng = self._rng()
+        nout = shape[0]
+        nin = int(_np.prod(shape[1:])) if len(shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = rng.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = rng.standard_normal((nout, nin))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        return (self.scale * q.reshape(shape)).astype(dtype)
+
+
+@register
+class Identity(Initializer):
+    def __init__(self, init_value=1):
+        super().__init__(init_value=init_value)
+        self.init_value = init_value
+
+    def _init_weight(self, name, shape, dtype):
+        if len(shape) != 2:
+            raise MXNetError("Identity initializer requires 2D shape")
+        return (self.init_value * _np.eye(*shape)).astype(dtype)
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernels (parity: deconv upsampling init)."""
+
+    def _init_weight(self, name, shape, dtype):
+        weight = _np.zeros(int(_np.prod(shape)), dtype="float32")
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return weight.reshape(shape).astype(dtype)
+
+
+@register("lstmbias")
+class LSTMBias(Initializer):
+    """Forget-gate bias init (parity: mx.init.LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, shape, dtype):
+        b = _np.zeros(shape, dtype=dtype)
+        n = shape[0] // 4
+        b[n:2 * n] = self.forget_bias  # i, f, c, o gate order (mx convention)
+        return b
+
+
+@register
+class Mixed(Initializer):
+    """Pattern-dispatched initializer (parity: mx.init.Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        import re
+        super().__init__()
+        if len(patterns) != len(initializers):
+            raise MXNetError("patterns and initializers length mismatch")
+        self.map = [(re.compile(p), get(i)) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, desc, shape, dtype="float32", force_weight=False):
+        for pat, init in self.map:
+            if pat.search(str(desc)):
+                return init(desc, shape, dtype, force_weight=force_weight)
+        raise MXNetError(f"no initializer pattern matches {desc!r}")

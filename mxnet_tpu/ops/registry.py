@@ -50,7 +50,7 @@ def apply_op(name, closed_fn, array_args, out=None, nodiff=False):
     out_list = list(out_data) if multi else [out_data]
     outs = [NDArray(d) for d in out_list]
     if rec:
-        record_node(name, vjp_fn, array_args, outs)
+        record_node(name, vjp_fn, array_args, outs, multi=multi)
     result = tuple(outs) if multi else outs[0]
     if out is not None:
         _write_out(out, result)

@@ -1,0 +1,195 @@
+"""Parameter/array serialization.
+
+Reference parity: src/ndarray/ndarray.cc — NDArray::Save/Load and the
+`.params` container written by MXNDArraySave (a dmlc stream of
+Map<string, NDArray>), consumed by gluon save_parameters/load_parameters.
+
+Native format here: NumPy `.npz` (zip of arrays keyed by name) with a
+`__format__` marker entry — self-describing, fast, and readable by any
+NumPy — plus a best-effort READER for the reference's binary `.params`
+format so existing MXNet model-zoo weights can be imported
+(`load_mxnet_params`). Writing the legacy format is out of scope.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as _np
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+FORMAT_KEY = "__mxnet_tpu_format__"
+FORMAT_VERSION = 1
+
+
+def save_ndarray_dict(filename, arrays: dict):
+    """Save {name: NDArray|np.ndarray} (parity: mx.nd.save)."""
+    out = {}
+    for k, v in arrays.items():
+        out[k] = _np.asarray(getattr(v, "asnumpy", lambda: v)())
+    out[FORMAT_KEY] = _np.asarray(FORMAT_VERSION)
+    with open(filename, "wb") as f:
+        _np.savez(f, **out)
+
+
+def load_ndarray_dict(filename) -> dict:
+    """Load a dict of NDArrays (parity: mx.nd.load). Transparently reads
+    either the native .npz format or a legacy MXNet .params binary."""
+    from .ndarray.ndarray import NDArray
+    try:
+        with _np.load(filename, allow_pickle=False) as z:
+            if FORMAT_KEY in z.files:
+                return {k: NDArray(jnp.asarray(z[k])) for k in z.files
+                        if k != FORMAT_KEY}
+            return {k: NDArray(jnp.asarray(z[k])) for k in z.files}
+    except (OSError, ValueError):
+        pass  # not a zip — try the legacy binary format
+    raw = load_mxnet_params(filename)
+    return {k: NDArray(jnp.asarray(v)) for k, v in raw.items()}
+
+
+def save_parameter_dict(filename, params, strip_prefix=""):
+    arrays = {}
+    for name, p in params.items():
+        if strip_prefix and name.startswith(strip_prefix):
+            name = name[len(strip_prefix):]
+        arrays[name] = p.data()
+    save_ndarray_dict(filename, arrays)
+
+
+def load_parameter_dict(filename, params, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False):
+    loaded = load_ndarray_dict(filename)
+    # strip legacy 'arg:'/'aux:' prefixes from Module-era checkpoints
+    loaded = {k.split(":", 1)[-1] if k.startswith(("arg:", "aux:")) else k: v
+              for k, v in loaded.items()}
+    for name, p in params.items():
+        if name not in loaded:
+            if allow_missing:
+                continue
+            raise MXNetError(
+                f"parameter {name} missing in file {filename} "
+                "(set allow_missing=True to skip)")
+        arr = loaded[name]
+        if cast_dtype:
+            arr = arr.astype(p.dtype)
+        p.set_data(arr)
+    if not ignore_extra:
+        extra = set(loaded) - set(params)
+        if extra:
+            raise MXNetError(
+                f"file {filename} has extra parameters {sorted(extra)[:8]}… "
+                "(set ignore_extra=True to skip)")
+
+
+# ---------------------------------------------------------------------------
+# Legacy MXNet .params binary reader (best-effort import path)
+# ---------------------------------------------------------------------------
+# Format (src/ndarray/ndarray.cc NDArray::Save + c_api MXNDArraySave):
+#   uint64 kMXAPINDArrayListMagic = 0x112
+#   uint64 reserved
+#   uint64 ndarray-count N; N × NDArray records
+#   uint64 key-count K;     K × (uint64 len + bytes) names
+# Each NDArray record (dense, v2 layout):
+#   uint64 NDARRAY_MAGIC = 0xF993fac9da950d0b
+#   uint32 version; [int32 stype if version >= 2 — dense = -1/1? gated]
+#   shape: uint32 ndim + int64[ndim]   (TShape dmlc serialization)
+#   int32 dev_type, int32 dev_id, int32 type_flag
+#   raw data bytes (size = prod(shape) * dtype-size)
+# Older v1 files lack magic/version and start directly with the shape.
+
+_MX_LIST_MAGIC = 0x112
+_MX_ND_MAGIC = 0xF993FAC9DA950D0B
+_MX_DTYPES = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+              4: "int32", 5: "int8", 6: "int64", 7: "bool",
+              12: "bfloat16"}
+
+
+class _Reader:
+    def __init__(self, data):
+        self.d = data
+        self.o = 0
+
+    def u32(self):
+        v = struct.unpack_from("<I", self.d, self.o)[0]
+        self.o += 4
+        return v
+
+    def i32(self):
+        v = struct.unpack_from("<i", self.d, self.o)[0]
+        self.o += 4
+        return v
+
+    def u64(self):
+        v = struct.unpack_from("<Q", self.d, self.o)[0]
+        self.o += 8
+        return v
+
+    def i64s(self, n):
+        v = struct.unpack_from(f"<{n}q", self.d, self.o)
+        self.o += 8 * n
+        return v
+
+    def raw(self, n):
+        v = self.d[self.o:self.o + n]
+        self.o += n
+        return v
+
+
+def _read_legacy_ndarray(r: _Reader):
+    start = r.o
+    magic = r.u64()
+    if magic == _MX_ND_MAGIC:
+        version = r.u32()
+        if version > 1:
+            stype = r.i32()
+            if stype not in (-1, 1):  # kDefaultStorage markers
+                raise MXNetError(
+                    "legacy .params contains a sparse NDArray; sparse import "
+                    "is not supported on TPU (dense-only)")
+        ndim = r.u32()
+        shape = r.i64s(ndim)
+    else:
+        # v0 layout: what we just read was the shape header
+        r.o = start
+        ndim = r.u32()
+        shape = r.i64s(ndim) if ndim else ()
+    _dev_type, _dev_id = r.i32(), r.i32()
+    type_flag = r.i32()
+    dtype = _MX_DTYPES.get(type_flag)
+    if dtype is None:
+        raise MXNetError(f"unknown MXNet dtype flag {type_flag}")
+    if dtype == "bfloat16":
+        import ml_dtypes
+        npdt = _np.dtype(ml_dtypes.bfloat16)
+    else:
+        npdt = _np.dtype(dtype)
+    count = int(_np.prod(shape)) if shape else 1
+    buf = r.raw(count * npdt.itemsize)
+    return _np.frombuffer(buf, dtype=npdt).reshape(shape).copy()
+
+
+def load_mxnet_params(filename) -> dict:
+    """Read a legacy Apache-MXNet `.params`/`.nd` file into numpy arrays.
+
+    Best-effort importer for model-zoo weights (SURVEY.md §5.4: 'keep
+    .params import for ecosystem compatibility')."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    r = _Reader(data)
+    magic = r.u64()
+    if magic != _MX_LIST_MAGIC:
+        raise MXNetError(
+            f"{filename}: not an MXNet NDArray-list file (magic {magic:#x})")
+    r.u64()  # reserved
+    n = r.u64()
+    arrays = [_read_legacy_ndarray(r) for _ in range(n)]
+    k = r.u64()
+    names = []
+    for _ in range(k):
+        ln = r.u64()
+        names.append(r.raw(ln).decode("utf-8"))
+    if names and len(names) == len(arrays):
+        return dict(zip(names, arrays))
+    return {str(i): a for i, a in enumerate(arrays)}

@@ -1,0 +1,285 @@
+"""Gluon Block/Parameter/layer tests.
+
+Modeled on the reference's tests/python/unittest/test_gluon.py patterns:
+deferred init, hybridize equivalence (eager vs traced outputs match),
+save/load round trips, BatchNorm running-stat updates.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import nn, loss as gloss
+
+
+def test_dense_deferred_init():
+    net = nn.Dense(8)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(4, 3))
+    y = net(x)
+    assert y.shape == (4, 8)
+    assert net.weight.shape == (8, 3)
+    assert net.bias.shape == (8,)
+
+
+def test_dense_explicit_in_units():
+    net = nn.Dense(5, in_units=7, use_bias=False)
+    net.initialize(mx.init.Xavier())
+    y = net(mx.nd.array(np.ones((2, 7))))
+    assert y.shape == (2, 5)
+
+
+def test_dense_no_flatten():
+    net = nn.Dense(6, flatten=False)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(2, 3, 4))
+    assert net(x).shape == (2, 3, 6)
+
+
+def test_sequential_and_collect_params():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(np.random.rand(2, 8))
+    y = net(x)
+    assert y.shape == (2, 4)
+    params = net.collect_params()
+    assert set(params) == {"0.weight", "0.bias", "1.weight", "1.bias"}
+
+
+def test_hybridize_matches_eager():
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="tanh"), nn.Dense(3))
+    net.initialize()
+    x = mx.nd.array(np.random.rand(5, 10).astype(np.float32))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y1 = net(x).asnumpy()  # trace + run
+    y2 = net(x).asnumpy()  # cached
+    np.testing.assert_allclose(y_eager, y1, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(y_eager, y2, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_grad_matches_eager():
+    np.random.seed(1)
+    net = nn.Dense(4, in_units=6)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(3, 6).astype(np.float32))
+
+    def grads():
+        with autograd.record():
+            y = net(x)
+            l = (y * y).sum()
+        l.backward()
+        return (net.weight.grad().asnumpy().copy(),
+                net.bias.grad().asnumpy().copy())
+
+    gw_e, gb_e = grads()
+    net.hybridize()
+    gw_h, gb_h = grads()
+    np.testing.assert_allclose(gw_e, gw_h, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(gb_e, gb_h, rtol=1e-5, atol=1e-6)
+
+
+def test_conv2d():
+    net = nn.Conv2D(8, kernel_size=3, padding=1)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(2, 3, 16, 16))
+    y = net(x)
+    assert y.shape == (2, 8, 16, 16)
+    assert net.weight.shape == (8, 3, 3, 3)
+
+
+def test_conv2d_transpose():
+    net = nn.Conv2DTranspose(4, kernel_size=2, strides=2)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(1, 3, 8, 8))
+    y = net(x)
+    assert y.shape == (1, 4, 16, 16)
+
+
+def test_pooling_layers():
+    x = mx.nd.array(np.random.rand(2, 3, 8, 8))
+    assert nn.MaxPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(2)(x).shape == (2, 3, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    assert nn.GlobalMaxPool2D()(x).shape == (2, 3, 1, 1)
+
+
+def test_batchnorm_running_stats():
+    net = nn.BatchNorm(in_channels=4, momentum=0.5)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(8, 4, 2, 2).astype(np.float32) * 3 + 1)
+    with autograd.record():
+        net(x)
+    rm = net.running_mean.data().asnumpy()
+    assert not np.allclose(rm, 0)  # stats moved toward the batch mean
+    # inference mode must not move stats
+    net(x)
+    np.testing.assert_allclose(net.running_mean.data().asnumpy(), rm)
+
+
+def test_batchnorm_hybrid_stats_update():
+    net = nn.BatchNorm(in_channels=3, momentum=0.0)  # full replace
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.rand(16, 3).astype(np.float32) * 2 + 5)
+    with autograd.record():
+        net(x)  # first call: eager path finishes deferred init
+    with autograd.record():
+        net(x)  # traced path
+    rm = net.running_mean.data().asnumpy()
+    np.testing.assert_allclose(rm, x.asnumpy().mean(axis=0), rtol=1e-4)
+
+
+def test_embedding():
+    net = nn.Embedding(10, 4)
+    net.initialize()
+    idx = mx.nd.array(np.array([[1, 2], [3, 4]]), dtype="int32")
+    y = net(idx)
+    assert y.shape == (2, 2, 4)
+
+
+def test_dropout_train_vs_eval():
+    net = nn.Dropout(0.5)
+    x = mx.nd.array(np.ones((100, 100)))
+    y_eval = net(x)
+    np.testing.assert_allclose(y_eval.asnumpy(), 1.0)
+    with autograd.record():
+        y_train = net(x)
+    a = y_train.asnumpy()
+    assert (a == 0).mean() > 0.3  # roughly half dropped
+    assert np.allclose(a[a != 0], 2.0)  # inverted scaling
+
+
+def test_layernorm_values():
+    net = nn.LayerNorm(in_channels=6)
+    net.initialize()
+    x = np.random.rand(4, 6).astype(np.float32)
+    y = net(mx.nd.array(x)).asnumpy()
+    ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+        x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net.initialize()
+    f = str(tmp_path / "model.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net2.load_parameters(f)
+    x = mx.nd.array(np.random.rand(3, 4))
+    np.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy(), rtol=1e-6)
+
+
+def test_parameter_shape_mismatch_raises():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    with pytest.raises(mx.MXNetError):
+        net.weight.set_data(mx.nd.array(np.zeros((5, 5))))
+
+
+def test_losses_basic():
+    pred = mx.nd.array(np.random.randn(4, 5).astype(np.float32))
+    label = mx.nd.array(np.array([0, 1, 2, 3]), dtype="int32")
+    l = gloss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (4,)
+    ref = -np.log(np.exp(pred.asnumpy()) /
+                  np.exp(pred.asnumpy()).sum(-1, keepdims=True))
+    ref = ref[np.arange(4), label.asnumpy()]
+    np.testing.assert_allclose(l.asnumpy(), ref, rtol=1e-4)
+
+    p2 = mx.nd.array(np.random.rand(4, 3).astype(np.float32))
+    t2 = mx.nd.array(np.random.rand(4, 3).astype(np.float32))
+    np.testing.assert_allclose(
+        gloss.L2Loss()(p2, t2).asnumpy(),
+        (0.5 * (p2.asnumpy() - t2.asnumpy()) ** 2).mean(-1), rtol=1e-6)
+    np.testing.assert_allclose(
+        gloss.L1Loss()(p2, t2).asnumpy(),
+        np.abs(p2.asnumpy() - t2.asnumpy()).mean(-1), rtol=1e-6)
+
+
+def test_loss_backward():
+    net = nn.Dense(3, in_units=5)
+    net.initialize()
+    lfn = gloss.SoftmaxCrossEntropyLoss()
+    x = mx.nd.array(np.random.rand(4, 5).astype(np.float32))
+    t = mx.nd.array(np.array([0, 1, 2, 0]), dtype="int32")
+    with autograd.record():
+        l = lfn(net(x), t).mean()
+    l.backward()
+    assert net.weight.grad() is not None
+    assert not np.allclose(net.weight.grad().asnumpy(), 0)
+
+
+def test_ctc_loss_simple():
+    # uniform logits over C classes: loss = -log P(label path)
+    T, N, C, L = 4, 1, 3, 1
+    pred = mx.nd.array(np.zeros((N, T, C), np.float32))
+    label = mx.nd.array(np.array([[1]]), dtype="int32")
+    l = gloss.CTCLoss()(pred, label)
+    # brute-force reference: sum over all alignments of length T emitting [1]
+    import itertools
+    p = 1.0 / 3
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        # collapse: remove repeats then blanks(0)
+        collapsed = []
+        prev = None
+        for s in path:
+            if s != prev:
+                collapsed.append(s)
+            prev = s
+        collapsed = [s for s in collapsed if s != 0]
+        if collapsed == [1]:
+            total += p ** T
+    np.testing.assert_allclose(l.asnumpy()[0], -np.log(total), rtol=1e-4)
+
+
+def test_block_repr_and_summary(capsys):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    net.summary(mx.nd.array(np.zeros((1, 3))))
+    out = capsys.readouterr().out
+    assert "Total params" in out
+    assert "Dense" in out
+
+
+def test_cast_dtype():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.cast("float16")
+    assert str(net.weight.data().dtype) == "float16"
+
+
+def test_explicit_bias_initializer_respected():
+    # regression: explicit per-param initializers must bypass name dispatch
+    net = nn.Dense(3, in_units=2, bias_initializer="ones")
+    net.initialize()
+    np.testing.assert_allclose(net.bias.data().asnumpy(), 1.0)
+    net2 = nn.Dense(3, in_units=2,
+                    weight_initializer=mx.init.Constant(2.0))
+    net2.initialize()
+    np.testing.assert_allclose(net2.weight.data().asnumpy(), 2.0)
+
+
+def test_sigmoid_bce_pos_weight():
+    pred = mx.nd.array(np.array([[0.5, -1.0, 2.0]], np.float32))
+    label = mx.nd.array(np.array([[1.0, 0.0, 1.0]], np.float32))
+    L = gloss.SigmoidBinaryCrossEntropyLoss()
+    base = L(pred, label).asnumpy()
+    weighted = L(pred, label, None, 5.0).asnumpy()
+    assert not np.allclose(base, weighted)
+    # reference formula: -mean(pw*z*log(sig) + (1-z)*log(1-sig))
+    x, z, pw = pred.asnumpy(), label.asnumpy(), 5.0
+    sig = 1 / (1 + np.exp(-x))
+    ref = -(pw * z * np.log(sig) + (1 - z) * np.log(1 - sig)).mean(-1)
+    np.testing.assert_allclose(weighted, ref, rtol=1e-4)
+    ref_base = -(z * np.log(sig) + (1 - z) * np.log(1 - sig)).mean(-1)
+    np.testing.assert_allclose(base, ref_base, rtol=1e-4)
