@@ -404,6 +404,8 @@ class HybridBlock(Block):
             return jitted(key, datas)
 
         from ..ops.registry import apply_op
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
         all_inputs = param_arrays + leaves
         outs = apply_op(f"CachedOp({type(self).__name__})", closed, all_inputs)
         if not isinstance(outs, tuple):
@@ -411,9 +413,12 @@ class HybridBlock(Block):
         n_real = meta["n_real_outputs"]
         real, aux = outs[:n_real], outs[n_real:]
         # write mutable state (BN stats) back into their parameters
-        for (p, _), new in zip(meta["state_updates"], aux):
+        for p, new in zip(meta["state_updates"], aux):
             p._data._rebind(new._data)
-        return meta["rebuild_out"](list(real))
+        result = meta["rebuild_out"](list(real))
+        for hook in self._forward_hooks:
+            hook(self, args, result)
+        return result
 
     def _build_cache_entry(self, params, spec, rebuild_all, n_params,
                            training):
@@ -443,7 +448,9 @@ class HybridBlock(Block):
                 out if isinstance(out, tuple) else (out,))
             single = not isinstance(out, tuple)
             meta["n_real_outputs"] = len(out_leaves)
-            meta["state_updates"] = updates
+            # keep only the Parameters — the traced values must not outlive
+            # the trace (leaked-tracer hazard)
+            meta["state_updates"] = [p for p, _ in updates]
 
             def _rebuild(arrs):
                 r = rebuild_out(arrs)
@@ -471,12 +478,17 @@ class HybridBlock(Block):
         params = self.collect_params()
         param_arrays = [p.data() for p in params.values()]
         # export requires a cached trace: users call net(x) once first,
-        # matching the reference's "forward at least once" requirement
-        if not self._jit_cache:
+        # matching the reference's "forward at least once" requirement.
+        # Only an INFERENCE-mode trace may be exported (a training trace
+        # would bake in dropout + batch-stat BN and aux outputs).
+        infer_entries = [(s, e) for s, e in self._jit_cache.items()
+                         if s[0] is False]
+        if not infer_entries:
             raise MXNetError(
-                "export requires a traced forward: hybridize() and call the "
-                "block once before export() (reference semantics)")
-        sig, (jitted, meta) = next(iter(self._jit_cache.items()))
+                "export requires an inference-mode traced forward: "
+                "hybridize() and call the block once OUTSIDE "
+                "autograd.record()/train_mode before export()")
+        sig, (jitted, meta) = infer_entries[0]
         # reconstruct example abstract inputs from the signature
         def avals_from_sig(s):
             out = []

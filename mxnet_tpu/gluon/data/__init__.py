@@ -1,0 +1,9 @@
+"""gluon.data (parity: python/mxnet/gluon/data/)."""
+from . import vision  # noqa: F401
+from .dataloader import (  # noqa: F401
+    DataLoader, default_batchify_fn, default_mp_batchify_fn)
+from .dataset import (  # noqa: F401
+    ArrayDataset, Dataset, RecordFileDataset, SimpleDataset)
+from .sampler import (  # noqa: F401
+    BatchSampler, FilterSampler, IntervalSampler, RandomSampler, Sampler,
+    SequentialSampler)

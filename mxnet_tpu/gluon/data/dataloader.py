@@ -1,0 +1,163 @@
+"""DataLoader.
+
+Reference parity: python/mxnet/gluon/data/dataloader.py — DataLoader
+(batch_size/shuffle/sampler/batch_sampler/last_batch, num_workers
+multiprocessing, prefetch, batchify_fn, pin_memory) and the default
+batchify functions.
+
+TPU-native notes: worker processes return numpy batches (host RAM); the
+loader stages them to device asynchronously (PjRt H2D is async — the
+analog of the reference's pinned-memory + kCopyToGPU engine lane,
+SURVEY.md §3.5). The reference's cpu_shared() shm IPC is replaced by
+plain pickle for now — the native high-throughput decode pipeline is the
+C++ extension milestone (SURVEY.md §7.2 M5).
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+
+import numpy as _np
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (parity: default_batchify_fn)."""
+    if isinstance(data[0], tuple):
+        return tuple(default_batchify_fn(list(d)) for d in zip(*data))
+    if isinstance(data[0], NDArray):
+        return NDArray(_np.stack([d.asnumpy() for d in data]))
+    arr = _np.asarray(data)
+    if arr.dtype == _np.float64:
+        arr = arr.astype(_np.float32)
+    return NDArray(arr)
+
+
+def _np_batchify(data):
+    """Worker-side batchify to numpy (picklable)."""
+    if isinstance(data[0], tuple):
+        return tuple(_np_batchify(list(d)) for d in zip(*data))
+    if isinstance(data[0], NDArray):
+        return _np.stack([d.asnumpy() for d in data])
+    arr = _np.asarray(data)
+    if arr.dtype == _np.float64:
+        arr = arr.astype(_np.float32)
+    return arr
+
+
+default_mp_batchify_fn = _np_batchify
+
+
+def _to_ndarray(batch):
+    if isinstance(batch, tuple):
+        return tuple(_to_ndarray(b) for b in batch)
+    if isinstance(batch, _np.ndarray):
+        return NDArray(batch)
+    return batch
+
+
+_worker_dataset = None
+
+
+def _worker_init(dataset):
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _worker_fn(samples, batchify_fn):
+    return batchify_fn([_worker_dataset[i] for i in samples])
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=False, timeout=120):
+        self._pool = None  # set before any validation can raise (__del__)
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._timeout = timeout
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError(
+                    "batch_size is required when batch_sampler is not given")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle must be False with custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise MXNetError(
+                "batch_size/shuffle/sampler/last_batch must not be set "
+                "with batch_sampler")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+        if batchify_fn is None:
+            self._batchify_fn = default_batchify_fn if num_workers == 0 \
+                else _np_batchify
+        else:
+            self._batchify_fn = batchify_fn
+        self._thread_pool = thread_pool
+        self._pool = None
+        if self._num_workers > 0:
+            if thread_pool:
+                from multiprocessing.pool import ThreadPool
+                self._pool = ThreadPool(self._num_workers,
+                                        _worker_init, (dataset,))
+            else:
+                ctx = mp.get_context("fork")
+                self._pool = ctx.Pool(self._num_workers, _worker_init,
+                                      (dataset,))
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __iter__(self):
+        if self._pool is None:
+            for batch_idx in self._batch_sampler:
+                batch = self._batchify_fn(
+                    [self._dataset[i] for i in batch_idx])
+                yield _to_ndarray(batch)
+            return
+
+        # async: keep `prefetch` batches in flight in the worker pool
+        import collections
+        pending = collections.deque()
+        it = iter(self._batch_sampler)
+
+        def submit():
+            try:
+                idx = next(it)
+            except StopIteration:
+                return False
+            pending.append(self._pool.apply_async(
+                _worker_fn, (idx, self._batchify_fn)))
+            return True
+
+        for _ in range(self._prefetch or 1):
+            if not submit():
+                break
+        while pending:
+            res = pending.popleft()
+            batch = res.get(self._timeout)
+            submit()
+            yield _to_ndarray(batch)
+
+    def __del__(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            try:
+                pool.terminate()
+                pool.join()
+            except Exception:
+                pass

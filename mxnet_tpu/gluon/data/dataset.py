@@ -1,0 +1,140 @@
+"""Datasets.
+
+Reference parity: python/mxnet/gluon/data/dataset.py — Dataset (transform /
+transform_first / filter / shard / take / sample), SimpleDataset,
+ArrayDataset, RecordFileDataset.
+"""
+from __future__ import annotations
+
+import os
+
+from ...base import MXNetError
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset"]
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def filter(self, fn):
+        indices = [i for i in range(len(self)) if fn(self[i])]
+        return _SampledDataset(self, indices)
+
+    def shard(self, num_shards, index):
+        """Contiguous-free round-robin shard (parity: Dataset.shard)."""
+        if not 0 <= index < num_shards:
+            raise MXNetError("shard index out of range")
+        indices = list(range(index, len(self), num_shards))
+        return _SampledDataset(self, indices)
+
+    def take(self, count):
+        count = min(count, len(self))
+        return _SampledDataset(self, list(range(count)))
+
+    def sample(self, sampler):
+        return _SampledDataset(self, list(sampler))
+
+    def transform(self, fn, lazy=True):
+        trans = _LazyTransformDataset(self, fn)
+        if not lazy:
+            return SimpleDataset([trans[i] for i in range(len(trans))])
+        return trans
+
+    def transform_first(self, fn, lazy=True):
+        def first(*args):
+            if len(args) == 1:
+                return fn(args[0])
+            return (fn(args[0]),) + args[1:]
+
+        return self.transform(_FirstTransform(fn), lazy)
+
+
+class _FirstTransform:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, *args):
+        if len(args) == 1:
+            return self._fn(args[0])
+        return (self._fn(args[0]),) + tuple(args[1:])
+
+
+class _LazyTransformDataset(Dataset):
+    def __init__(self, dataset, fn):
+        self._dataset = dataset
+        self._fn = fn
+
+    def __len__(self):
+        return len(self._dataset)
+
+    def __getitem__(self, idx):
+        item = self._dataset[idx]
+        if isinstance(item, tuple):
+            return self._fn(*item)
+        return self._fn(item)
+
+
+class _SampledDataset(Dataset):
+    def __init__(self, dataset, indices):
+        self._dataset = dataset
+        self._indices = indices
+
+    def __len__(self):
+        return len(self._indices)
+
+    def __getitem__(self, idx):
+        return self._dataset[self._indices[idx]]
+
+
+class SimpleDataset(Dataset):
+    def __init__(self, data):
+        self._data = data
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        return self._data[idx]
+
+
+class ArrayDataset(Dataset):
+    """Zip of equal-length arrays (parity: ArrayDataset)."""
+
+    def __init__(self, *args):
+        if not args:
+            raise MXNetError("ArrayDataset needs at least one array")
+        self._length = len(args[0])
+        for i, a in enumerate(args):
+            if len(a) != self._length:
+                raise MXNetError(
+                    f"all arrays must have the same length; arg {i} has "
+                    f"{len(a)} != {self._length}")
+        self._data = args
+
+    def __len__(self):
+        return self._length
+
+    def __getitem__(self, idx):
+        if len(self._data) == 1:
+            return self._data[0][idx]
+        return tuple(d[idx] for d in self._data)
+
+
+class RecordFileDataset(Dataset):
+    """Dataset over an indexed RecordIO file (parity: RecordFileDataset)."""
+
+    def __init__(self, filename):
+        self._filename = filename
+        idx_file = os.path.splitext(filename)[0] + ".idx"
+        from ...io.recordio import MXIndexedRecordIO
+        self._record = MXIndexedRecordIO(idx_file, filename, "r")
+
+    def __getitem__(self, idx):
+        return self._record.read_idx(self._record.keys[idx])
+
+    def __len__(self):
+        return len(self._record.keys)

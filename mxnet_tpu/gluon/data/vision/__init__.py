@@ -1,0 +1,5 @@
+"""gluon.data.vision (parity: python/mxnet/gluon/data/vision/)."""
+from . import transforms  # noqa: F401
+from .datasets import (  # noqa: F401
+    CIFAR10, CIFAR100, FashionMNIST, ImageFolderDataset, ImageRecordDataset,
+    MNIST)

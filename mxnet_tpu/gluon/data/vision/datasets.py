@@ -1,0 +1,216 @@
+"""Vision datasets.
+
+Reference parity: python/mxnet/gluon/data/vision/datasets.py — MNIST,
+FashionMNIST, CIFAR10, CIFAR100, ImageRecordDataset, ImageFolderDataset.
+No-network environment: datasets read standard local files (the reference
+downloads on demand; here a missing file raises with the expected layout
+spelled out).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as _np
+
+from ....base import MXNetError
+from ....ndarray.ndarray import NDArray
+from ..dataset import ArrayDataset, Dataset, RecordFileDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, train, transform):
+        self._root = os.path.expanduser(root)
+        self._train = train
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._get_data()
+
+    def __getitem__(self, idx):
+        img = NDArray(self._data[idx])
+        label = int(self._label[idx])
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self._label)
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from the standard IDX files (parity: vision.MNIST). Expects
+    train-images-idx3-ubyte(.gz) etc. under root."""
+
+    _files = {
+        True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    }
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+    def _open(self, name):
+        path = os.path.join(self._root, name)
+        if os.path.exists(path):
+            return open(path, "rb")
+        if os.path.exists(path + ".gz"):
+            return gzip.open(path + ".gz", "rb")
+        raise MXNetError(
+            f"MNIST file {name}(.gz) not found under {self._root}; this "
+            "environment has no network — place the standard IDX files "
+            "there")
+
+    def _get_data(self):
+        img_name, lbl_name = self._files[self._train]
+        with self._open(lbl_name) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            self._label = _np.frombuffer(f.read(), _np.uint8)[:n]
+        with self._open(img_name) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = _np.frombuffer(f.read(), _np.uint8)
+            self._data = data.reshape(n, rows, cols, 1)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self,
+                 root=os.path.join("~", ".mxnet", "datasets",
+                                   "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR-10 from the python-pickle batches (parity: vision.CIFAR10)."""
+
+    def __init__(self,
+                 root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None):
+        super().__init__(root, train, transform)
+
+    def _batches(self):
+        if self._train:
+            return [f"data_batch_{i}" for i in range(1, 6)]
+        return ["test_batch"]
+
+    def _find(self, name):
+        for sub in ("", "cifar-10-batches-py"):
+            p = os.path.join(self._root, sub, name)
+            if os.path.exists(p):
+                return p
+        # try the tar
+        tar = os.path.join(self._root, "cifar-10-python.tar.gz")
+        if os.path.exists(tar):
+            with tarfile.open(tar) as t:
+                t.extractall(self._root)
+            return self._find(name)
+        raise MXNetError(
+            f"CIFAR batch {name} not found under {self._root} (no network "
+            "— place cifar-10-python.tar.gz or the extracted batches there)")
+
+    def _get_data(self):
+        datas, labels = [], []
+        for name in self._batches():
+            with open(self._find(name), "rb") as f:
+                batch = pickle.load(f, encoding="latin1")
+            datas.append(batch["data"].reshape(-1, 3, 32, 32)
+                         .transpose(0, 2, 3, 1))
+            labels.append(_np.asarray(
+                batch.get("labels", batch.get("fine_labels"))))
+        self._data = _np.concatenate(datas)
+        self._label = _np.concatenate(labels).astype(_np.int32)
+
+
+class CIFAR100(CIFAR10):
+    def __init__(self,
+                 root=os.path.join("~", ".mxnet", "datasets", "cifar100"),
+                 fine_label=True, train=True, transform=None):
+        self._fine = fine_label
+        super().__init__(root, train, transform)
+
+    def _batches(self):
+        return ["train"] if self._train else ["test"]
+
+    def _find(self, name):
+        for sub in ("", "cifar-100-python"):
+            p = os.path.join(self._root, sub, name)
+            if os.path.exists(p):
+                return p
+        raise MXNetError(
+            f"CIFAR-100 batch {name} not found under {self._root}")
+
+    def _get_data(self):
+        datas, labels = [], []
+        key = "fine_labels" if self._fine else "coarse_labels"
+        for name in self._batches():
+            with open(self._find(name), "rb") as f:
+                batch = pickle.load(f, encoding="latin1")
+            datas.append(batch["data"].reshape(-1, 3, 32, 32)
+                         .transpose(0, 2, 3, 1))
+            labels.append(_np.asarray(batch[key]))
+        self._data = _np.concatenate(datas)
+        self._label = _np.concatenate(labels).astype(_np.int32)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Images in an indexed RecordIO file (parity: ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ....io.recordio import unpack_img
+        record = super().__getitem__(idx)
+        header, img = unpack_img(record, self._flag)
+        label = header.label
+        if isinstance(label, _np.ndarray) and label.size == 1:
+            label = float(label)
+        img = NDArray(img)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """root/category/image.jpg layout (parity: ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = {".jpg", ".jpeg", ".png", ".bmp"}
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fname in sorted(os.listdir(path)):
+                if os.path.splitext(fname)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, fname), label))
+
+    def __getitem__(self, idx):
+        path, label = self.items[idx]
+        from PIL import Image
+        img = _np.asarray(Image.open(path).convert(
+            "RGB" if self._flag else "L"))
+        if not self._flag:
+            img = img[..., None]
+        img = NDArray(img)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,240 @@
+"""Vision transforms.
+
+Reference parity: python/mxnet/gluon/data/vision/transforms.py — Compose,
+Cast, ToTensor, Normalize, Resize, CenterCrop, RandomResizedCrop,
+RandomFlipLeftRight/TopBottom, color jitter family. Images are HWC
+(uint8 or float) NDArrays as in the reference; ToTensor converts to CHW
+float32 /255.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from .... import rng as _rng
+from ....base import MXNetError
+from ....ndarray.ndarray import NDArray
+from ...block import Block
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomColorJitter"]
+
+
+class _Transform(Block):
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class Compose(_Transform):
+    def __init__(self, transforms):
+        super().__init__()
+        self._transforms = transforms
+
+    def forward(self, x):
+        for t in self._transforms:
+            x = t(x)
+        return x
+
+
+class Cast(_Transform):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(_Transform):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (parity: ToTensor)."""
+
+    def forward(self, x):
+        d = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        d = d.astype(jnp.float32) / 255.0
+        if d.ndim == 3:
+            d = jnp.transpose(d, (2, 0, 1))
+        elif d.ndim == 4:
+            d = jnp.transpose(d, (0, 3, 1, 2))
+        return NDArray(d)
+
+
+class Normalize(_Transform):
+    """(x - mean) / std per channel on CHW input (parity: Normalize)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = _np.asarray(mean, _np.float32)
+        self._std = _np.asarray(std, _np.float32)
+
+    def forward(self, x):
+        d = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        shape = (-1, 1, 1) if d.ndim == 3 else (1, -1, 1, 1)
+        mean = jnp.reshape(jnp.asarray(self._mean), shape)
+        std = jnp.reshape(jnp.asarray(self._std), shape)
+        return NDArray((d - mean) / std)
+
+
+def _resize_hwc(d, size, interpolation="bilinear"):
+    if isinstance(size, int):
+        size = (size, size)
+    w, h = size  # reference passes (width, height)
+    method = {0: "nearest", 1: "bilinear", 2: "cubic",
+              "nearest": "nearest", "bilinear": "bilinear"}.get(
+        interpolation, "bilinear")
+    out_shape = (h, w, d.shape[2]) if d.ndim == 3 else \
+        (d.shape[0], h, w, d.shape[3])
+    orig_dtype = d.dtype
+    out = jax.image.resize(d.astype(jnp.float32), out_shape, method=method)
+    if jnp.issubdtype(orig_dtype, jnp.integer):
+        out = jnp.clip(jnp.round(out), 0, 255)
+    return out.astype(orig_dtype)
+
+
+class Resize(_Transform):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interp = interpolation
+
+    def forward(self, x):
+        d = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        size = self._size
+        if self._keep and isinstance(size, int):
+            h, w = d.shape[-3], d.shape[-2]
+            if h < w:
+                size = (int(size * w / h), size)
+            else:
+                size = (size, int(size * h / w))
+        return NDArray(_resize_hwc(d, size, self._interp))
+
+
+class CenterCrop(_Transform):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._interp = interpolation
+
+    def forward(self, x):
+        d = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        w, h = self._size
+        H, W = d.shape[-3], d.shape[-2]
+        if H < h or W < w:
+            return NDArray(_resize_hwc(d, self._size, self._interp))
+        y0, x0 = (H - h) // 2, (W - w) // 2
+        return NDArray(d[..., y0:y0 + h, x0:x0 + w, :])
+
+
+class RandomResizedCrop(_Transform):
+    """Random area/aspect crop then resize (parity: RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+        self._interp = interpolation
+
+    def forward(self, x):
+        d = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        H, W = int(d.shape[-3]), int(d.shape[-2])
+        area = H * W
+        rng = _np.random
+        for _ in range(10):
+            target = rng.uniform(*self._scale) * area
+            ar = _np.exp(rng.uniform(_np.log(self._ratio[0]),
+                                     _np.log(self._ratio[1])))
+            w = int(round(_np.sqrt(target * ar)))
+            h = int(round(_np.sqrt(target / ar)))
+            if w <= W and h <= H:
+                x0 = rng.randint(0, W - w + 1)
+                y0 = rng.randint(0, H - h + 1)
+                crop = d[..., y0:y0 + h, x0:x0 + w, :]
+                return NDArray(_resize_hwc(crop, self._size, self._interp))
+        return CenterCrop(self._size, self._interp)(NDArray(d))
+
+
+class RandomFlipLeftRight(_Transform):
+    def forward(self, x):
+        d = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        if _np.random.rand() < 0.5:
+            d = jnp.flip(d, axis=-2)
+        return NDArray(d)
+
+
+class RandomFlipTopBottom(_Transform):
+    def forward(self, x):
+        d = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        if _np.random.rand() < 0.5:
+            d = jnp.flip(d, axis=-3)
+        return NDArray(d)
+
+
+class RandomBrightness(_Transform):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        d = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        alpha = 1.0 + _np.random.uniform(-self._b, self._b)
+        return NDArray(jnp.clip(d.astype(jnp.float32) * alpha, 0,
+                                255 if jnp.issubdtype(d.dtype, jnp.integer)
+                                else jnp.inf).astype(d.dtype))
+
+
+class RandomContrast(_Transform):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        d = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        alpha = 1.0 + _np.random.uniform(-self._c, self._c)
+        f = d.astype(jnp.float32)
+        gray = jnp.mean(f, axis=tuple(range(f.ndim - 3, f.ndim)),
+                        keepdims=True)
+        out = gray + alpha * (f - gray)
+        if jnp.issubdtype(d.dtype, jnp.integer):
+            out = jnp.clip(out, 0, 255)
+        return NDArray(out.astype(d.dtype))
+
+
+class RandomSaturation(_Transform):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        d = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        alpha = 1.0 + _np.random.uniform(-self._s, self._s)
+        f = d.astype(jnp.float32)
+        gray = jnp.mean(f, axis=-1, keepdims=True)
+        out = gray + alpha * (f - gray)
+        if jnp.issubdtype(d.dtype, jnp.integer):
+            out = jnp.clip(out, 0, 255)
+        return NDArray(out.astype(d.dtype))
+
+
+class RandomColorJitter(_Transform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        ts = []
+        if brightness:
+            ts.append(RandomBrightness(brightness))
+        if contrast:
+            ts.append(RandomContrast(contrast))
+        if saturation:
+            ts.append(RandomSaturation(saturation))
+        self._ts = ts
+
+    def forward(self, x):
+        order = _np.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[i](x)
+        return x

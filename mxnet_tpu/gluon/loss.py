@@ -199,7 +199,9 @@ def _ctc_kernel(pred, label, pred_lengths, label_lengths, blank_first):
     t_idx = jnp.asarray(pred_lengths, jnp.int32) - 1
     end = 2 * jnp.asarray(label_lengths, jnp.int32)
     a_end = hist[t_idx, jnp.arange(N), end]
-    a_end1 = hist[t_idx, jnp.arange(N), jnp.maximum(end - 1, 0)]
+    a_end1 = jnp.where(end > 0,
+                       hist[t_idx, jnp.arange(N), jnp.maximum(end - 1, 0)],
+                       neg_inf)  # empty labels: only the blank path counts
     ll = jnp.logaddexp(a_end, a_end1)
     return -ll
 

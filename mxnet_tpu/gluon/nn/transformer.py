@@ -1,0 +1,144 @@
+"""Transformer building blocks.
+
+Reference parity: the reference has no transformer layers in core — GluonNLP
+builds BERT from Dense + the fused `interleaved_matmul_selfatt_*` CUDA
+kernels (src/operator/contrib/transformer.cu, SURVEY.md §2.3). Here the
+attention core is `ops.nn.dot_product_attention` (XLA einsum → MXU; the
+flash/ring Pallas variants in ops/attention.py slot in transparently), and
+the blocks are plain Gluon layers so every parallelism flavor attaches via
+sharding rules (parallel.megatron_dense_rules matches these attr names:
+query/key/value/proj, fc1/fc2).
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...ops import nn as _opnn, tensor as _opt
+from ..block import HybridBlock
+from .basic_layers import Dense, Dropout, LayerNorm
+
+__all__ = ["MultiHeadAttention", "PositionwiseFFN",
+           "TransformerEncoderLayer", "TransformerEncoder"]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Multi-head attention over (B, T, C) inputs.
+
+    attention_impl: 'auto' | 'xla' | 'flash' — 'flash' selects the Pallas
+    kernel on TPU (ops/attention.py); 'auto' picks flash when available.
+    """
+
+    def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
+                 attention_impl="auto", causal=False, **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise MXNetError(f"units {units} not divisible by heads "
+                             f"{num_heads}")
+        self._units = units
+        self._num_heads = num_heads
+        self._dropout = dropout
+        self._causal = causal
+        self._impl = attention_impl
+        self.query = Dense(units, flatten=False, use_bias=use_bias,
+                           in_units=units)
+        self.key = Dense(units, flatten=False, use_bias=use_bias,
+                         in_units=units)
+        self.value = Dense(units, flatten=False, use_bias=use_bias,
+                           in_units=units)
+        self.proj = Dense(units, flatten=False, use_bias=use_bias,
+                          in_units=units)
+
+    def _split(self, x):
+        b, t, _ = x.shape
+        h, d = self._num_heads, self._units // self._num_heads
+        return x.reshape((b, t, h, d)).transpose((0, 2, 1, 3))
+
+    def forward(self, x, mask=None, kv=None):
+        kv = x if kv is None else kv
+        q = self._split(self.query(x))
+        k = self._split(self.key(kv))
+        v = self._split(self.value(kv))
+        if mask is not None and mask.ndim == 2:
+            # (B, Tk) valid mask → (B, 1, 1, Tk) broadcast over heads/query
+            mask = mask.reshape((mask.shape[0], 1, 1, mask.shape[1]))
+        out = _opnn.dot_product_attention(
+            q, k, v, mask, causal=self._causal, dropout_p=self._dropout,
+            impl=self._impl)
+        b, h, t, d = out.shape
+        out = out.transpose((0, 2, 1, 3)).reshape((b, t, h * d))
+        return self.proj(out)
+
+
+class PositionwiseFFN(HybridBlock):
+    """Transformer FFN: fc1 → activation → fc2 (+dropout)."""
+
+    def __init__(self, units, hidden_size, activation="gelu", dropout=0.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.fc1 = Dense(hidden_size, flatten=False, in_units=units)
+        self.fc2 = Dense(units, flatten=False, in_units=hidden_size)
+        self._activation = activation
+        self.dropout = Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        h = _opnn.Activation(self.fc1(x), act_type=self._activation)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        return self.fc2(h)
+
+
+class TransformerEncoderLayer(HybridBlock):
+    """Post-LN (BERT-style) or pre-LN transformer encoder layer."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 attention_dropout=0.0, activation="gelu", pre_norm=False,
+                 layer_norm_eps=1e-12, attention_impl="auto", **kwargs):
+        super().__init__(**kwargs)
+        self._pre_norm = pre_norm
+        self.attn = MultiHeadAttention(units, num_heads,
+                                       dropout=attention_dropout,
+                                       attention_impl=attention_impl)
+        self.ffn = PositionwiseFFN(units, hidden_size, activation, dropout)
+        self.ln1 = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.ln2 = LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.dropout = Dropout(dropout) if dropout else None
+
+    def forward(self, x, mask=None):
+        if self._pre_norm:
+            h = self.attn(self.ln1(x), mask)
+            if self.dropout is not None:
+                h = self.dropout(h)
+            x = x + h
+            h = self.ffn(self.ln2(x))
+            if self.dropout is not None:
+                h = self.dropout(h)
+            return x + h
+        h = self.attn(x, mask)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        x = self.ln1(x + h)
+        h = self.ffn(x)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        return self.ln2(x + h)
+
+
+class TransformerEncoder(HybridBlock):
+    """Stack of encoder layers."""
+
+    def __init__(self, num_layers, units, hidden_size, num_heads,
+                 dropout=0.0, attention_dropout=0.0, activation="gelu",
+                 pre_norm=False, layer_norm_eps=1e-12, attention_impl="auto",
+                 **kwargs):
+        super().__init__(**kwargs)
+        for i in range(num_layers):
+            self.register_child(
+                TransformerEncoderLayer(
+                    units, hidden_size, num_heads, dropout,
+                    attention_dropout, activation, pre_norm, layer_norm_eps,
+                    attention_impl),
+                name=f"layer{i}")
+
+    def forward(self, x, mask=None):
+        for layer in self._children.values():
+            x = layer(x, mask)
+        return x

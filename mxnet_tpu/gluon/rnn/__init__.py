@@ -1,0 +1,5 @@
+"""gluon.rnn — recurrent layers and cells (parity: python/mxnet/gluon/rnn/)."""
+from .rnn_cell import (  # noqa: F401
+    BidirectionalCell, DropoutCell, GRUCell, LSTMCell, RecurrentCell,
+    ResidualCell, RNNCell, SequentialRNNCell, ZoneoutCell)
+from .rnn_layer import GRU, LSTM, RNN  # noqa: F401

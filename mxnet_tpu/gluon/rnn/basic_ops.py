@@ -1,0 +1,41 @@
+"""Shared single-step cell math for the unfused cells (parity with the
+fused kernel's gate order so cell and layer results match)."""
+from __future__ import annotations
+
+from ...ops.registry import op
+import jax
+import jax.numpy as jnp
+
+
+@op("rnn_cell_step", register=False)
+def _cell_step_op(x, h, i2h_w, h2h_w, i2h_b, h2h_b, mode="lstm", c=None):
+    pre_i = jnp.matmul(x, i2h_w.T) + i2h_b
+    pre_h = jnp.matmul(h, h2h_w.T) + h2h_b
+    if mode == "lstm":
+        i, f, g, o = jnp.split(pre_i + pre_h, 4, axis=-1)
+        new_c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        new_h = jax.nn.sigmoid(o) * jnp.tanh(new_c)
+        return new_h, new_c
+    if mode == "gru":
+        xr, xz, xn = jnp.split(pre_i, 3, axis=-1)
+        hr, hz, hn = jnp.split(pre_h, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        return (1 - z) * n + z * h
+    act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+    return act(pre_i + pre_h)
+
+
+def _cell_forward(cell, mode, x, states):
+    """Run one step for a cell Block; returns (output, new_states)."""
+    if mode == "lstm":
+        h, c = _cell_step_op(
+            x, states[0], cell.i2h_weight.data(), cell.h2h_weight.data(),
+            cell.i2h_bias.data(), cell.h2h_bias.data(), mode=mode,
+            c=states[1])
+        return h, [h, c]
+    h = _cell_step_op(
+        x, states[0], cell.i2h_weight.data(), cell.h2h_weight.data(),
+        cell.i2h_bias.data(), cell.h2h_bias.data(), mode=mode)
+    return h, [h]

@@ -1,0 +1,251 @@
+"""Unfused RNN cells.
+
+Reference parity: python/mxnet/gluon/rnn/rnn_cell.py — RecurrentCell base
+(begin_state, unroll), RNNCell, LSTMCell, GRUCell, SequentialRNNCell,
+DropoutCell, ZoneoutCell, ResidualCell, BidirectionalCell. Cells are the
+per-step API (decode loops, custom unrolls); the fused layers in
+rnn_layer.py are the throughput path.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ...ops import nn as _opnn, random as _oprand
+from ..block import HybridBlock
+from .basic_ops import _cell_forward
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
+           "ResidualCell", "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    """Base cell (parity: gluon.rnn.RecurrentCell)."""
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, dtype="float32", **kwargs):
+        return [NDArray(jnp.zeros(info["shape"], dtype))
+                for info in self.state_info(batch_size)]
+
+    def reset(self):
+        pass
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Python unroll (parity: RecurrentCell.unroll). inputs: (N, T, C)
+        for NTC."""
+        axis = layout.find("T")
+        if begin_state is None:
+            b = inputs.shape[layout.find("N")]
+            begin_state = self.begin_state(b, dtype=str(inputs.dtype))
+        states = begin_state
+        outputs = []
+        from ...ops import tensor as _t
+        for t in range(length):
+            x_t = _t.slice_axis(inputs, axis=axis, begin=t, end=t + 1)
+            x_t = x_t.squeeze(axis=axis)
+            out, states = self(x_t, states)
+            outputs.append(out)
+        if merge_outputs is False:
+            return outputs, states
+        stacked = _t.stack(*outputs, axis=axis)
+        return stacked, states
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._mode = "rnn_" + ("tanh" if activation == "tanh" else "relu")
+        self._hidden_size = hidden_size
+        _make_cell_params(self, hidden_size, input_size, 1)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        _infer_cell_shape(self, x, 1)
+
+    def forward(self, x, states):
+        return _cell_forward(self, self._mode, x, states)
+
+
+class LSTMCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._mode = "lstm"
+        self._hidden_size = hidden_size
+        _make_cell_params(self, hidden_size, input_size, 4)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        _infer_cell_shape(self, x, 4)
+
+    def forward(self, x, states):
+        return _cell_forward(self, "lstm", x, states)
+
+
+class GRUCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(**kwargs)
+        self._mode = "gru"
+        self._hidden_size = hidden_size
+        _make_cell_params(self, hidden_size, input_size, 3)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        _infer_cell_shape(self, x, 3)
+
+    def forward(self, x, states):
+        return _cell_forward(self, "gru", x, states)
+
+
+def _make_cell_params(cell, hidden_size, input_size, gates):
+    from ..parameter import Parameter
+    cell.i2h_weight = Parameter("i2h_weight",
+                                shape=(gates * hidden_size, input_size),
+                                allow_deferred_init=True)
+    cell.h2h_weight = Parameter("h2h_weight",
+                                shape=(gates * hidden_size, hidden_size))
+    cell.i2h_bias = Parameter("i2h_bias", shape=(gates * hidden_size,),
+                              init="zeros")
+    cell.h2h_bias = Parameter("h2h_bias", shape=(gates * hidden_size,),
+                              init="zeros")
+
+
+def _infer_cell_shape(cell, x, gates):
+    cell.i2h_weight.shape = (gates * cell._hidden_size, x.shape[-1])
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells (parity: SequentialRNNCell)."""
+
+    def add(self, cell):
+        self.register_child(cell)
+        return self
+
+    def state_info(self, batch_size=0):
+        out = []
+        for c in self._children.values():
+            out += c.state_info(batch_size)
+        return out
+
+    def __len__(self):
+        return len(self._children)
+
+    def forward(self, x, states):
+        next_states = []
+        i = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            x, s = cell(x, states[i:i + n])
+            next_states += s
+            i += n
+        return x, next_states
+
+
+class ModifierCell(RecurrentCell):
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, x, states):
+        if self._rate > 0:
+            x = _opnn.Dropout(x, p=self._rate)
+        return x, states
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (parity: ZoneoutCell)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(base_cell, **kwargs)
+        self._zo, self._zs = zoneout_outputs, zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        self._prev_output = None
+
+    def forward(self, x, states):
+        out, new_states = self.base_cell(x, states)
+        from ... import autograd
+        if autograd.is_training():
+            if self._zo > 0:
+                prev = self._prev_output
+                if prev is None:
+                    from ...ops import tensor as _t
+                    prev = _t.zeros_like(out)
+                m = _oprand.bernoulli(p=self._zo, size=out.shape,
+                                      dtype=str(out.dtype))
+                out = m * prev + (1 - m) * out
+            if self._zs > 0:
+                merged = []
+                for old, new in zip(states, new_states):
+                    m = _oprand.bernoulli(p=self._zs, size=old.shape,
+                                          dtype=str(old.dtype))
+                    merged.append(m * old + (1 - m) * new)
+                new_states = merged
+        self._prev_output = out
+        return out, new_states
+
+
+class ResidualCell(ModifierCell):
+    def forward(self, x, states):
+        out, new_states = self.base_cell(x, states)
+        return out + x, new_states
+
+
+class BidirectionalCell(RecurrentCell):
+    """Run two cells over opposite directions at unroll time."""
+
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) + \
+            self.r_cell.state_info(batch_size)
+
+    def forward(self, x, states):
+        raise MXNetError(
+            "BidirectionalCell supports unroll() only (as the reference)")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ...ops import tensor as _t
+        axis = layout.find("T")
+        b = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(b, dtype=str(inputs.dtype))
+        nl = len(self.l_cell.state_info())
+        lo, ls = self.l_cell.unroll(length, inputs, begin_state[:nl],
+                                    layout, merge_outputs=True)
+        rev = _t.flip(inputs, axis=axis)
+        ro, rs = self.r_cell.unroll(length, rev, begin_state[nl:],
+                                    layout, merge_outputs=True)
+        ro = _t.flip(ro, axis=axis)
+        out = _t.concat(lo, ro, dim=2)
+        return out, ls + rs

@@ -1,0 +1,144 @@
+"""Fused RNN layers: RNN / LSTM / GRU.
+
+Reference parity: python/mxnet/gluon/rnn/rnn_layer.py — _RNNLayer base
+(flat cuDNN-layout parameter vector, TNC/NTC layouts, bidirectional,
+begin_state), RNN, LSTM, GRU. The kernel is ops.nn.RNN (lax.scan replacing
+the cuDNN fused RNN, SURVEY.md §2.3 'Sequence/RNN' row); the flat parameter
+layout is kept so reference checkpoints map 1:1.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ...ops import nn as _opnn
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, mode, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", dtype="float32",
+                 projection_size=None, **kwargs):
+        super().__init__(**kwargs)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"invalid RNN layout {layout}")
+        if projection_size is not None:
+            raise MXNetError("projection_size is not supported")
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._mode = mode
+        self._dtype = dtype
+        size = _opnn.rnn_param_size(mode, num_layers, input_size,
+                                    hidden_size, bidirectional) \
+            if input_size else 0
+        self.rnn_param = Parameter(
+            "rnn_param", shape=(size,) if size else (0,), dtype=dtype,
+            init=i2h_weight_initializer, allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        input_size = x.shape[-1]
+        self._input_size = input_size
+        size = _opnn.rnn_param_size(self._mode, self._num_layers,
+                                    input_size, self._hidden_size,
+                                    self._dir == 2)
+        self.rnn_param.shape = (size,)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial zero states (parity: _RNNLayer.begin_state)."""
+        states = []
+        for info in self.state_info(batch_size):
+            states.append(NDArray(jnp.zeros(info["shape"], self._dtype)))
+        return states
+
+    def forward(self, inputs, states=None):
+        x = inputs
+        if self._layout == "NTC":
+            x = x.transpose((1, 0, 2))
+        T, B, _ = x.shape
+        explicit_states = states is not None
+        if states is None:
+            states = self.begin_state(B)
+        elif isinstance(states, NDArray):
+            states = [states]
+        param = self.rnn_param.data()
+        if self._mode == "lstm":
+            out = _opnn.RNN(x, param, states[0], states[1],
+                            state_size=self._hidden_size,
+                            num_layers=self._num_layers, mode=self._mode,
+                            bidirectional=self._dir == 2, p=self._dropout)
+            y, h, c = out
+            new_states = [h, c]
+        else:
+            out = _opnn.RNN(x, param, states[0],
+                            state_size=self._hidden_size,
+                            num_layers=self._num_layers, mode=self._mode,
+                            bidirectional=self._dir == 2, p=self._dropout)
+            y, h = out
+            new_states = [h]
+        if self._layout == "NTC":
+            y = y.transpose((1, 0, 2))
+        if explicit_states:
+            return y, new_states
+        return y
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._input_size or None} -> "
+                f"{self._hidden_size}, {self._layout}, "
+                f"num_layers={self._num_layers}"
+                f"{', bidirectional' if self._dir == 2 else ''})")
+
+
+class RNN(_RNNLayer):
+    """Elman RNN with tanh/relu (parity: gluon.rnn.RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (parity: gluon.rnn.LSTM)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU, cuDNN semantics (parity: gluon.rnn.GRU)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

@@ -1,0 +1,140 @@
+"""Trainer: applies an optimizer to a set of Parameters.
+
+Reference parity: python/mxnet/gluon/trainer.py — Trainer(params, optimizer,
+optimizer_params, kvstore, update_on_kvstore): allreduce_grads / step /
+update split, rescale_grad = scale/batch_size per step, save/load_states.
+
+TPU-native mapping (SURVEY.md §5.8): the reference's kvstore push/pull
+becomes — nothing, for a sharded-data program: when parameters/batches are
+laid out over a mesh (mxnet_tpu.parallel), gradients come out of backward
+already all-reduced by XLA collectives compiled into the step. The kvstore
+argument is accepted and routed to the KVStore facade for API parity; on a
+single device it is a no-op.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import optimizer as _opt
+from .parameter import Parameter, ParameterDict
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            param_list = []
+            for key in sorted(params.keys()):
+                param_list.append(params[key])
+            params = param_list
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError(
+                "first argument must be a list/dict of Parameters, got "
+                f"{type(params)}")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"invalid parameter {p!r}")
+            self._params.append(p)
+            self._param2idx[p.name] = i
+        self._compression_params = compression_params
+        self._scale = 1.0
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_type = kvstore
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, _opt.Optimizer):
+            if optimizer_params:
+                raise MXNetError(
+                    "optimizer_params must be empty when optimizer is an "
+                    "Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = _opt.create(optimizer,
+                                          param_dict=param_dict,
+                                          **optimizer_params)
+        self._updaters = _opt.get_updater(self._optimizer)
+
+    # -- properties --------------------------------------------------------
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.learning_rate = lr
+
+    # -- the step ----------------------------------------------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce (no-op single-device) + update with grads rescaled by
+        1/batch_size (parity: Trainer.step)."""
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        """Parity: Trainer.allreduce_grads. Under a mesh the gradients are
+        reduced inside the compiled step (XLA psum); nothing to do here.
+        Multi-process (multi-host) reduction goes through the KVStore
+        facade when configured."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is not None:
+            for i, p in enumerate(self._params):
+                if p.grad_req != "null":
+                    self._kvstore.pushpull(i, p.grad(), out=p.grad())
+
+    def _init_kvstore(self):
+        self._kvstore = None
+        if self._kvstore_type not in (None, "device", "local"):
+            from .. import kvstore as kv
+            store = kv.create(self._kvstore_type)
+            if store.num_workers > 1:
+                self._kvstore = store
+        self._kv_initialized = True
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Update without allreduce (parity: Trainer.update — for users who
+        reduced manually)."""
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            if p._data is None:
+                if ignore_stale_grad:
+                    continue
+                raise MXNetError(
+                    f"parameter {p.name} has not been initialized")
+            self._updaters(i, p.grad(), p.data())
+            if p.grad_req == "write":
+                p.zero_grad()
+
+    def zero_grad(self):
+        for p in self._params:
+            p.zero_grad()
+
+    # -- state persistence -------------------------------------------------
+    def save_states(self, fname):
+        """Parity: Trainer.save_states (optimizer/updater state dump)."""
+        with open(fname, "wb") as f:
+            f.write(self._updaters.get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            self._updaters.set_states(f.read())
+        self._optimizer = self._updaters.optimizer
+        self._optimizer.param_dict = {i: p for i, p in
+                                      enumerate(self._params)}

@@ -1,0 +1,10 @@
+"""I/O: RecordIO container + legacy DataIter surface.
+
+Reference parity: python/mxnet/io/ + recordio.py. The legacy C++-backed
+iterators (ImageRecordIter et al.) map to the gluon.data pipeline; an
+NDArrayIter shim covers the Module-era API.
+"""
+from .recordio import (  # noqa: F401
+    IRHeader, MXIndexedRecordIO, MXRecordIO, pack, pack_img, unpack,
+    unpack_img)
+from .io import DataBatch, DataDesc, DataIter, NDArrayIter  # noqa: F401

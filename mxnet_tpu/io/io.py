@@ -1,0 +1,120 @@
+"""Legacy DataIter surface (Module-era API).
+
+Reference parity: python/mxnet/io/io.py — DataIter, DataBatch, DataDesc,
+NDArrayIter (pad/discard/roll_over), ResizeIter/PrefetchingIter are
+de-scoped (gluon.data.DataLoader is the supported pipeline; this shim keeps
+old training scripts importable).
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["DataIter", "DataBatch", "DataDesc", "NDArrayIter"]
+
+DataDesc = namedtuple("DataDesc", ["name", "shape"])
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        raise NotImplementedError
+
+    def iter_next(self):
+        try:
+            self.next()
+            return True
+        except StopIteration:
+            return False
+
+
+def _as_dict(data, default_name):
+    if data is None:
+        return {}
+    if isinstance(data, (NDArray, _np.ndarray)):
+        return {default_name: data}
+    if isinstance(data, (list, tuple)):
+        return {f"{default_name}{i if i else ''}": d
+                for i, d in enumerate(data)}
+    return dict(data)
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (parity: mx.io.NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = {k: _np.asarray(v.asnumpy() if isinstance(v, NDArray)
+                                    else v)
+                     for k, v in _as_dict(data, data_name).items()}
+        self.label = {k: _np.asarray(v.asnumpy() if isinstance(v, NDArray)
+                                     else v)
+                      for k, v in _as_dict(label, label_name).items()}
+        self.num_data = len(next(iter(self.data.values())))
+        self.shuffle = shuffle
+        if last_batch_handle not in ("pad", "discard", "roll_over"):
+            raise MXNetError(f"bad last_batch_handle {last_batch_handle}")
+        self.last_batch_handle = last_batch_handle
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:])
+                for k, v in self.data.items()]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:])
+                for k, v in self.label.items()]
+
+    def reset(self):
+        self.cursor = 0
+        self.order = _np.random.permutation(self.num_data) if self.shuffle \
+            else _np.arange(self.num_data)
+
+    def next(self):
+        if self.cursor >= self.num_data:
+            raise StopIteration
+        end = self.cursor + self.batch_size
+        pad = 0
+        if end > self.num_data:
+            if self.last_batch_handle == "discard":
+                raise StopIteration
+            pad = end - self.num_data
+        idx = self.order[self.cursor:min(end, self.num_data)]
+        if pad:
+            idx = _np.concatenate([idx, self.order[:pad]])
+        self.cursor = end
+        data = [NDArray(v[idx]) for v in self.data.values()]
+        label = [NDArray(v[idx]) for v in self.label.values()]
+        return DataBatch(data, label, pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

@@ -1,0 +1,243 @@
+"""RecordIO container format — binary-compatible with the reference.
+
+Reference parity: python/mxnet/recordio.py (MXRecordIO, MXIndexedRecordIO,
+IRHeader pack/unpack) + dmlc-core's recordio spec:
+
+  every record:  [kMagic:u32][lrec:u32][data][pad to 4-byte boundary]
+    kMagic = 0xced7230a
+    lrec   = cflag(3 bits, in the upper bits) | length(29 bits)
+    cflag  = 0 whole record; 1 start-of-multi; 2 middle; 3 end
+  (multi-part records occur when data contains the magic — the writer
+  splits at magic collisions; this implementation handles both sides.)
+
+IRHeader (image records, parity: mx.recordio.IRHeader/pack/unpack):
+  struct { u32 flag; f32 label; u64 id; u64 id2; } little-endian,
+  flag>0 → flag extra f32 labels follow, replacing the scalar label.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import struct
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_KMAGIC = 0xCED7230A
+_LREC_BITS = 29
+_LREC_MASK = (1 << _LREC_BITS) - 1
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << _LREC_BITS) | length
+
+
+def _decode_lrec(lrec):
+    return lrec >> _LREC_BITS, lrec & _LREC_MASK
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (parity: mx.recordio.MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        if flag not in ("r", "w"):
+            raise MXNetError("flag must be 'r' or 'w'")
+        self.open()
+
+    def open(self):
+        self.fid = open(self.uri, "rb" if self.flag == "r" else "wb")
+        self.writable = self.flag == "w"
+
+    def close(self):
+        if self.fid is not None:
+            self.fid.close()
+            self.fid = None
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def tell(self):
+        return self.fid.tell()
+
+    def write(self, buf: bytes):
+        if not self.writable:
+            raise MXNetError("not opened for writing")
+        # split payload at magic collisions into multi-part records
+        magic_bytes = struct.pack("<I", _KMAGIC)
+        parts = []
+        start = 0
+        while True:
+            i = buf.find(magic_bytes, start)
+            if i < 0:
+                parts.append(buf[start:])
+                break
+            parts.append(buf[start:i])
+            start = i + 4
+        for n, part in enumerate(parts):
+            if len(parts) == 1:
+                cflag = 0
+            elif n == 0:
+                cflag = 1
+            elif n == len(parts) - 1:
+                cflag = 3
+            else:
+                cflag = 2
+            self.fid.write(struct.pack("<II", _KMAGIC,
+                                       _encode_lrec(cflag, len(part))))
+            self.fid.write(part)
+            pad = (4 - len(part) % 4) % 4
+            if pad:
+                self.fid.write(b"\x00" * pad)
+
+    def read(self):
+        if self.writable:
+            raise MXNetError("not opened for reading")
+        out = []
+        expect_more = False
+        while True:
+            head = self.fid.read(8)
+            if len(head) < 8:
+                if expect_more:
+                    raise MXNetError("truncated multi-part record")
+                return None
+            magic, lrec = struct.unpack("<II", head)
+            if magic != _KMAGIC:
+                raise MXNetError(f"bad record magic {magic:#x}")
+            cflag, length = _decode_lrec(lrec)
+            data = self.fid.read(length)
+            pad = (4 - length % 4) % 4
+            if pad:
+                self.fid.read(pad)
+            if cflag == 0:
+                if expect_more:
+                    raise MXNetError("unexpected whole record inside multi")
+                return data
+            out.append(data)
+            if cflag == 1:
+                expect_more = True
+            elif cflag == 3:
+                return struct.pack("<I", _KMAGIC).join(out)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """RecordIO + .idx sidecar for random access (parity:
+    MXIndexedRecordIO; idx line format: '<key>\\t<offset>')."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if flag == "r" and os.path.exists(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) >= 2:
+                        k = key_type(parts[0])
+                        self.idx[k] = int(parts[1])
+                        self.keys.append(k)
+
+    def close(self):
+        if getattr(self, "writable", False) and self.idx:
+            with open(self.idx_path, "w") as f:
+                for k in self.keys:
+                    f.write(f"{k}\t{self.idx[k]}\n")
+        super().close()
+
+    def seek(self, idx):
+        self.fid.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IRHeader = collections.namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Pack IRHeader + payload (parity: mx.recordio.pack)."""
+    label = header.label
+    if isinstance(label, (list, tuple, _np.ndarray)):
+        arr = _np.asarray(label, _np.float32)
+        header = header._replace(flag=arr.size, label=0.0)
+        payload = struct.pack(_IR_FORMAT, *header) + arr.tobytes() + s
+    else:
+        payload = struct.pack(_IR_FORMAT, header.flag, float(label),
+                              header.id, header.id2) + s
+    return payload
+
+
+def unpack(s: bytes):
+    """Unpack to (IRHeader, payload) (parity: mx.recordio.unpack)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = _np.frombuffer(s[:header.flag * 4], _np.float32).copy()
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode image + pack (needs an encoder; cv2 unavailable → PIL)."""
+    try:
+        import cv2
+        ok, buf = cv2.imencode(img_fmt, img,
+                               [cv2.IMWRITE_JPEG_QUALITY, quality])
+        if not ok:
+            raise MXNetError("cv2.imencode failed")
+        return pack(header, buf.tobytes())
+    except ImportError:
+        import io as _io
+        from PIL import Image
+        pil = Image.fromarray(img[..., ::-1] if img.ndim == 3 else img)
+        bio = _io.BytesIO()
+        fmt = "JPEG" if "jpg" in img_fmt or "jpeg" in img_fmt else "PNG"
+        pil.save(bio, format=fmt, quality=quality)
+        return pack(header, bio.getvalue())
+
+
+def unpack_img(s, iscolor=1):
+    """Unpack + decode image to a numpy BGR array (reference convention)."""
+    header, img_bytes = unpack(s)
+    try:
+        import cv2
+        img = cv2.imdecode(_np.frombuffer(img_bytes, _np.uint8), iscolor)
+    except ImportError:
+        import io as _io
+        from PIL import Image
+        pil = Image.open(_io.BytesIO(img_bytes))
+        img = _np.asarray(pil)
+        if img.ndim == 3:
+            img = img[..., ::-1]  # RGB→BGR like cv2
+    return header, img

@@ -1,0 +1,391 @@
+"""Evaluation metrics.
+
+Reference parity: python/mxnet/metric.py (v2 gluon/metric.py) — EvalMetric
+base (update(labels, preds) accumulation, get/get_name_value/reset),
+Accuracy, TopKAccuracy, F1, MCC, MAE, MSE, RMSE, CrossEntropy, NegativeLogLikelihood,
+Perplexity, PearsonCorrelation, CompositeEvalMetric, CustomMetric, Loss,
+plus the dmlc-style registry (`metric.create('acc')`).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError, Registry
+
+_REG = Registry("metric")
+register = _REG.register
+
+
+def create(metric, *args, **kwargs):
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, (list, tuple)):
+        composite = CompositeEvalMetric()
+        for m in metric:
+            composite.add(create(m))
+        return composite
+    return _REG.create(metric, *args, **kwargs)
+
+
+def _asnumpy(x):
+    if hasattr(x, "asnumpy"):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def check_label_shapes(labels, preds, shape=False):
+    if (hasattr(labels, "__len__") and hasattr(preds, "__len__")
+            and len(labels) != len(preds)):
+        raise MXNetError(
+            f"labels/preds count mismatch: {len(labels)} vs {len(preds)}")
+
+
+class EvalMetric:
+    """Base metric (parity: mx.metric.EvalMetric)."""
+
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def update_dict(self, label, pred):
+        if self.output_names is not None:
+            pred = [pred[n] for n in self.output_names]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[n] for n in self.label_names]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def __str__(self):
+        return f"EvalMetric: {dict([self.get_name_value()[0]])}"
+
+
+def _aslist(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+@register("accuracy", aliases=("acc",))
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = _aslist(labels), _aslist(preds)
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            p = _asnumpy(pred)
+            l = _asnumpy(label).astype('int64')
+            if p.ndim > l.ndim:
+                p = p.argmax(axis=self.axis)
+            p = p.astype('int64').reshape(-1)
+            l = l.reshape(-1)
+            self.sum_metric += float((p == l).sum())
+            self.num_inst += len(l)
+
+
+@register("top_k_accuracy", aliases=("topk", "top_k_acc"))
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kwargs):
+        super().__init__(f"{name}_{top_k}", **kwargs)
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        labels, preds = _aslist(labels), _aslist(preds)
+        for label, pred in zip(labels, preds):
+            p = _asnumpy(pred)
+            l = _asnumpy(label).astype('int64').reshape(-1)
+            topk = _np.argpartition(p, -self.top_k, axis=-1)[..., -self.top_k:]
+            topk = topk.reshape(len(l), -1)
+            self.sum_metric += float((topk == l[:, None]).any(-1).sum())
+            self.num_inst += len(l)
+
+
+@register("f1")
+class F1(EvalMetric):
+    """Binary F1 (parity: mx.metric.F1, average='macro'|'micro')."""
+
+    def __init__(self, name="f1", average="macro", **kwargs):
+        super().__init__(name, **kwargs)
+        self.average = average
+        self.reset()
+
+    def reset(self):
+        super().reset()
+        self._tp = self._fp = self._fn = 0.0
+        self._scores = []
+
+    def update(self, labels, preds):
+        labels, preds = _aslist(labels), _aslist(preds)
+        for label, pred in zip(labels, preds):
+            p = _asnumpy(pred)
+            l = _asnumpy(label).reshape(-1).astype('int64')
+            if p.ndim > 1 and p.shape[-1] > 1:
+                p = p.argmax(-1)
+            else:
+                p = (p.reshape(-1) > 0.5)
+            p = p.astype('int64').reshape(-1)
+            tp = float(((p == 1) & (l == 1)).sum())
+            fp = float(((p == 1) & (l == 0)).sum())
+            fn = float(((p == 0) & (l == 1)).sum())
+            if self.average == "micro":
+                self._tp += tp
+                self._fp += fp
+                self._fn += fn
+            else:
+                prec = tp / (tp + fp) if tp + fp else 0.0
+                rec = tp / (tp + fn) if tp + fn else 0.0
+                f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+                self._scores.append(f1)
+            self.num_inst += 1
+
+    def get(self):
+        if self.average == "micro":
+            prec = self._tp / (self._tp + self._fp) if self._tp + self._fp \
+                else 0.0
+            rec = self._tp / (self._tp + self._fn) if self._tp + self._fn \
+                else 0.0
+            f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+            return (self.name, f1)
+        if not self._scores:
+            return (self.name, float("nan"))
+        return (self.name, float(_np.mean(self._scores)))
+
+
+@register("mcc")
+class MCC(EvalMetric):
+    """Matthews correlation coefficient (binary)."""
+
+    def __init__(self, name="mcc", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        super().reset()
+        self._tp = self._fp = self._fn = self._tn = 0.0
+
+    def update(self, labels, preds):
+        labels, preds = _aslist(labels), _aslist(preds)
+        for label, pred in zip(labels, preds):
+            p = _asnumpy(pred)
+            l = _asnumpy(label).reshape(-1).astype('int64')
+            if p.ndim > 1 and p.shape[-1] > 1:
+                p = p.argmax(-1)
+            else:
+                p = p.reshape(-1) > 0.5
+            p = p.astype('int64').reshape(-1)
+            self._tp += float(((p == 1) & (l == 1)).sum())
+            self._fp += float(((p == 1) & (l == 0)).sum())
+            self._fn += float(((p == 0) & (l == 1)).sum())
+            self._tn += float(((p == 0) & (l == 0)).sum())
+            self.num_inst += len(l)
+
+    def get(self):
+        tp, fp, fn, tn = self._tp, self._fp, self._fn, self._tn
+        den = _np.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        mcc = (tp * tn - fp * fn) / den if den else 0.0
+        return (self.name, float(mcc))
+
+
+@register("mae")
+class MAE(EvalMetric):
+    def __init__(self, name="mae", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_aslist(labels), _aslist(preds)):
+            l, p = _asnumpy(label), _asnumpy(pred)
+            self.sum_metric += float(_np.abs(l.reshape(p.shape) - p).mean())
+            self.num_inst += 1
+
+
+@register("mse")
+class MSE(EvalMetric):
+    def __init__(self, name="mse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_aslist(labels), _aslist(preds)):
+            l, p = _asnumpy(label), _asnumpy(pred)
+            self.sum_metric += float(((l.reshape(p.shape) - p) ** 2).mean())
+            self.num_inst += 1
+
+
+@register("rmse")
+class RMSE(MSE):
+    def __init__(self, name="rmse", **kwargs):
+        EvalMetric.__init__(self, name, **kwargs)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, float(_np.sqrt(self.sum_metric / self.num_inst)))
+
+
+@register("ce", aliases=("cross-entropy", "crossentropy"))
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(_aslist(labels), _aslist(preds)):
+            l = _asnumpy(label).astype('int64').reshape(-1)
+            p = _asnumpy(pred).reshape(len(l), -1)
+            prob = p[_np.arange(len(l)), l]
+            self.sum_metric += float(-_np.log(prob + self.eps).sum())
+            self.num_inst += len(l)
+
+
+@register("nll_loss")
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", **kwargs):
+        EvalMetric.__init__(self, name, **kwargs)
+        self.eps = eps
+
+
+@register("perplexity")
+class Perplexity(CrossEntropy):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity",
+                 **kwargs):
+        EvalMetric.__init__(self, name, **kwargs)
+        self.eps = 1e-12
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        for label, pred in zip(_aslist(labels), _aslist(preds)):
+            l = _asnumpy(label).astype('int64').reshape(-1)
+            p = _asnumpy(pred).reshape(len(l), -1)
+            prob = p[_np.arange(len(l)), l]
+            if self.ignore_label is not None:
+                keep = l != self.ignore_label
+                prob, n = prob[keep], int(keep.sum())
+            else:
+                n = len(l)
+            self.sum_metric += float(-_np.log(prob + self.eps).sum())
+            self.num_inst += n
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, float(_np.exp(self.sum_metric / self.num_inst)))
+
+
+@register("pearsonr")
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        super().reset()
+        self._labels, self._preds = [], []
+
+    def update(self, labels, preds):
+        for label, pred in zip(_aslist(labels), _aslist(preds)):
+            self._labels.append(_asnumpy(label).reshape(-1))
+            self._preds.append(_asnumpy(pred).reshape(-1))
+            self.num_inst += 1
+
+    def get(self):
+        if not self._labels:
+            return (self.name, float("nan"))
+        l = _np.concatenate(self._labels)
+        p = _np.concatenate(self._preds)
+        return (self.name, float(_np.corrcoef(l, p)[0, 1]))
+
+
+@register("loss")
+class Loss(EvalMetric):
+    """Mean of raw loss outputs (parity: mx.metric.Loss)."""
+
+    def __init__(self, name="loss", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, _, preds):
+        for pred in _aslist(preds):
+            p = _asnumpy(pred)
+            self.sum_metric += float(p.sum())
+            self.num_inst += p.size
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kwargs):
+        super().__init__(name, **kwargs)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        return self.metrics[index]
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def get(self):
+        names, vals = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            vals.append(v)
+        return (names, vals)
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name="custom", allow_extra_outputs=False,
+                 **kwargs):
+        super().__init__(f"custom({name})", **kwargs)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        for label, pred in zip(_aslist(labels), _aslist(preds)):
+            out = self._feval(_asnumpy(label), _asnumpy(pred))
+            if isinstance(out, tuple):
+                s, n = out
+                self.sum_metric += s
+                self.num_inst += n
+            else:
+                self.sum_metric += out
+                self.num_inst += 1
+
+
+def np_metric(name=None, allow_extra_outputs=False):
+    """Decorator form (parity: mx.metric.np)."""
+
+    def deco(f):
+        return CustomMetric(f, name or f.__name__, allow_extra_outputs)
+
+    return deco

@@ -1,0 +1,15 @@
+"""Model zoo (parity: gluon/model_zoo + the GluonCV/GluonNLP families the
+reference's baselines name: ResNet, BERT, GPT-2, transformer NMT, SSD)."""
+from ..base import Registry
+
+_REG = Registry("model")
+register = _REG.register
+
+
+def get_model(name, **kwargs):
+    return _REG.create(name, **kwargs)
+
+
+from .bert import (  # noqa: F401,E402
+    BertConfig, BertForMaskedLM, BertForPretraining, BertModel,
+    bert_base_config, bert_large_config)

@@ -638,9 +638,19 @@ def RNN(data, parameters, state, state_cell=None, state_size=None,
 
 @op("dot_product_attention")
 def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
-                          dropout_p=0.0):
-    """q,k,v: (B, H, T, D). Baseline XLA path; attention.py provides the
-    flash/ring variants with identical semantics."""
+                          dropout_p=0.0, impl="auto"):
+    """q,k,v: (B, H, T, D). impl: 'auto'|'xla'|'flash' — 'flash' routes to
+    the blockwise/Pallas kernel in ops/attention.py (same semantics, O(T)
+    memory); 'auto' switches to flash for long sequences (Tq >= 1024, no
+    dropout) where the O(T^2) logits matrix stops fitting comfortably; for
+    short sequences one fused XLA softmax-attention is fastest.
+    Fully-masked rows yield zeros (not NaN) on every path."""
+    if impl == "flash" or (impl == "auto" and dropout_p == 0.0
+                           and q.shape[-2] >= 1024):
+        from . import attention as _att
+        if _att.flash_eligible(q, k, v, mask, dropout_p):
+            return _att.flash_attention_data(q, k, v, mask=mask, scale=scale,
+                                             causal=causal)
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / _pymath.sqrt(d)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * s
@@ -652,6 +662,11 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
     if mask is not None:
         logits = jnp.where(mask, logits, -jnp.inf)
     w = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if causal or mask is not None:
+        # fully-masked rows: zeros, matching the flash kernel (softmax over
+        # all -inf would yield NaN)
+        any_valid = jnp.isfinite(logits).any(axis=-1, keepdims=True)
+        w = jnp.where(any_valid, w, jnp.zeros((), w.dtype))
     if dropout_p > 0 and is_training():
         kk = _rng.next_key()
         keep = jax.random.bernoulli(kk, 1.0 - dropout_p, w.shape)

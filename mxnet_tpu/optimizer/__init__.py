@@ -1,0 +1,5 @@
+"""Optimizers (parity: python/mxnet/optimizer/)."""
+from .optimizer import (  # noqa: F401
+    LAMB, LARS, NAG, SGD, SGLD, Adagrad, AdaDelta, Adam, AdamW, DCASGD,
+    Ftrl, Optimizer, RMSProp, Signum, Test, create, register)
+from .updater import Updater, get_updater  # noqa: F401

@@ -1,0 +1,754 @@
+"""Optimizers.
+
+Reference parity: python/mxnet/optimizer/* (Optimizer base: rescale_grad,
+clip_gradient, lr/wd mults, num_update tracking, multi-precision) and the
+fused update kernels in src/operator/optimizer_op.cc (sgd_update,
+sgd_mom_update, mp_sgd_*, adam_update, lamb_*, ftrl, rmsprop, signum, nag).
+
+TPU-native design: each update rule is ONE jitted pure function over
+(weight, grad, *state, lr, wd) — XLA fuses the whole rule into a single
+HBM-bound kernel, the analog of the reference's fused CUDA update ops.
+Hyperparameters that change per step (lr, wd) are traced scalars so no
+recompilation happens when a scheduler varies them. Multi-precision
+(fp16/bf16 weights + fp32 master copy) mirrors mp_sgd_update &c.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError, Registry
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "Adagrad", "AdaDelta",
+           "RMSProp", "Ftrl", "Signum", "LARS", "LAMB", "DCASGD", "SGLD",
+           "create", "register"]
+
+_REG = Registry("optimizer")
+register = _REG.register
+
+
+def create(name, **kwargs):
+    return _REG.create(name, **kwargs)
+
+
+def _to_jax(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+def _clip(g, clip_gradient):
+    if clip_gradient is not None and clip_gradient > 0:
+        return jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+class Optimizer:
+    """Base optimizer (parity: mx.optimizer.Optimizer)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, aggregate_num=0, use_fused_step=True):
+        self.rescale_grad = rescale_grad
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.lr_scheduler = lr_scheduler
+        self._learning_rate = learning_rate if learning_rate is not None \
+            else 0.01
+        if lr_scheduler is not None and learning_rate is not None:
+            lr_scheduler.base_lr = learning_rate
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.multi_precision = multi_precision
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = param_dict or {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.aggregate_num = aggregate_num
+
+    # -- registry-compatible construction ---------------------------------
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        return create(name, **kwargs)
+
+    # -- lr/wd ------------------------------------------------------------
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self._learning_rate
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError(
+                "cannot set learning_rate directly when lr_scheduler is set")
+        self._learning_rate = lr
+
+    def set_learning_rate(self, lr):
+        self.learning_rate = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _get_param(self, index):
+        if index in self.param_dict:
+            return self.param_dict[index]
+        return None
+
+    def _get_lr(self, index):
+        lr = self.learning_rate
+        p = self._get_param(index)
+        if p is not None:
+            return lr * p.lr_mult
+        name = self.idx2name.get(index, index)
+        return lr * self.lr_mult.get(name, 1.0)
+
+    def _get_wd(self, index):
+        wd = self.wd
+        p = self._get_param(index)
+        if p is not None:
+            return wd * p.wd_mult
+        name = self.idx2name.get(index, index)
+        return wd * self.wd_mult.get(name, 1.0)
+
+    def _update_count(self, index):
+        self._index_update_count.setdefault(index, self.begin_num_update)
+        self._index_update_count[index] += 1
+        self.num_update = max(self.num_update,
+                              self._index_update_count[index])
+
+    def _t(self, index):
+        return self._index_update_count.get(index, self.begin_num_update)
+
+    # -- state ------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype in (jnp.float16,
+                                                     jnp.bfloat16):
+            master = NDArray(_to_jax(weight).astype(jnp.float32))
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    # -- update -----------------------------------------------------------
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and isinstance(state, tuple) and \
+                isinstance(state[0], NDArray) and \
+                state[0].dtype == jnp.float32 and \
+                weight.dtype in (jnp.float16, jnp.bfloat16):
+            master, inner = state
+            g32 = NDArray(_to_jax(grad).astype(jnp.float32))
+            self.update(index, master, g32, inner)
+            weight._rebind(_to_jax(master).astype(weight.dtype))
+            return
+        self.update(index, weight, grad, state)
+
+    # allow batched interface used by Updater/Trainer
+    def update_multi(self, indices, weights, grads, states):
+        for i, w, g, s in zip(indices, weights, grads, states):
+            self.update_multi_precision(i, w, g, s)
+
+    # -- functional (fused) interface -------------------------------------
+    # Used by parallel.TrainStep to compile the whole update into the step
+    # program (the analog of the reference's preloaded_multi_sgd / multi_lamb
+    # fused multi-tensor kernels, SURVEY.md §2.2 optimizer row). All inputs/
+    # outputs are jax arrays; `t` is a traced step counter so no recompiles.
+    fused_supported = False
+
+    def init_state_arrays(self, w):
+        """Per-parameter optimizer state as a tuple of jax arrays."""
+        raise MXNetError(
+            f"{type(self).__name__} has no fused/functional path; use the "
+            "eager Trainer or pick SGD/Adam/AdamW/LAMB")
+
+    def apply_arrays(self, w, g, states, lr, wd, t):
+        """Pure update: returns (new_w, new_states). Must be traceable."""
+        raise MXNetError(
+            f"{type(self).__name__} has no fused/functional path")
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.learning_rate})"
+
+
+# ---------------------------------------------------------------------------
+# jitted update kernels (the analog of src/operator/optimizer_op.cc)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _sgd_kernel(w, g, lr, wd, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip)
+    return w - lr * (g.astype(w.dtype) + wd * w)
+
+
+@jax.jit
+def _sgd_mom_kernel(w, g, mom, lr, wd, mu, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip).astype(w.dtype)
+    mom = mu * mom - lr * (g + wd * w)
+    return w + mom, mom
+
+
+@jax.jit
+def _nag_kernel(w, g, mom, lr, wd, mu, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip).astype(w.dtype) + wd * w
+    mom = mu * mom - lr * g
+    return w + mu * mom - lr * g, mom
+
+
+@jax.jit
+def _adam_kernel(w, g, m, v, lr_t, wd, b1, b2, eps, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip).astype(w.dtype)
+    g = g + wd * w
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * jnp.square(g)
+    w = w - lr_t * m / (jnp.sqrt(v) + eps)
+    return w, m, v
+
+
+@jax.jit
+def _adamw_kernel(w, g, m, v, lr, eta, wd, b1, b2, eps, bc1, bc2,
+                  rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip).astype(w.dtype)
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * jnp.square(g)
+    mhat = m / bc1
+    vhat = v / bc2
+    w = w - eta * (lr * mhat / (jnp.sqrt(vhat) + eps) + wd * w)
+    return w, m, v
+
+
+@jax.jit
+def _adagrad_kernel(w, g, h, lr, wd, eps, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip).astype(w.dtype) + wd * w
+    h = h + jnp.square(g)
+    return w - lr * g / (jnp.sqrt(h) + eps), h
+
+
+@jax.jit
+def _adadelta_kernel(w, g, acc_g, acc_d, rho, eps, wd, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip).astype(w.dtype) + wd * w
+    acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    d = jnp.sqrt(acc_d + eps) / jnp.sqrt(acc_g + eps) * g
+    acc_d = rho * acc_d + (1 - rho) * jnp.square(d)
+    return w - d, acc_g, acc_d
+
+
+@jax.jit
+def _rmsprop_kernel(w, g, n, lr, wd, rho, eps, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip).astype(w.dtype) + wd * w
+    n = rho * n + (1 - rho) * jnp.square(g)
+    return w - lr * g / (jnp.sqrt(n) + eps), n
+
+
+@jax.jit
+def _rmsprop_center_kernel(w, g, n, gbar, mom, lr, wd, rho, mu, eps,
+                           rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip).astype(w.dtype) + wd * w
+    n = rho * n + (1 - rho) * jnp.square(g)
+    gbar = rho * gbar + (1 - rho) * g
+    mom = mu * mom - lr * g / jnp.sqrt(n - jnp.square(gbar) + eps)
+    return w + mom, n, gbar, mom
+
+
+@jax.jit
+def _ftrl_kernel(w, g, z, n, lr, wd, lamda1, beta, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip).astype(w.dtype)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    z = z + g - sigma * w
+    w = jnp.where(
+        jnp.abs(z) > lamda1,
+        -(z - jnp.sign(z) * lamda1) / ((beta + jnp.sqrt(new_n)) / lr + wd),
+        0.0)
+    return w.astype(g.dtype), z, new_n
+
+
+@jax.jit
+def _signum_kernel(w, g, mom, lr, wd, mu, wd_lh, rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip).astype(w.dtype)
+    mom = mu * mom - (1 - mu) * (g + wd * w)
+    return (1 - lr * wd_lh) * w + lr * jnp.sign(mom), mom
+
+
+@jax.jit
+def _lars_phase(w, g, rescale, clip, wd):
+    g = jnp.clip(g * rescale, -clip, clip).astype(w.dtype)
+    wnorm = jnp.linalg.norm(w.ravel())
+    gnorm = jnp.linalg.norm(g.ravel())
+    return g, wnorm, gnorm
+
+
+@jax.jit
+def _lamb_kernel(w, g, m, v, lr, wd, b1, b2, eps, bc1, bc2, lower, upper,
+                 rescale, clip):
+    g = jnp.clip(g * rescale, -clip, clip).astype(w.dtype)
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * jnp.square(g)
+    mhat = m / bc1
+    vhat = v / bc2
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * w
+    wnorm = jnp.linalg.norm(w.ravel())
+    rnorm = jnp.linalg.norm(r.ravel())
+    wnorm = jnp.clip(wnorm, lower, upper)
+    trust = jnp.where(jnp.logical_and(wnorm > 0, rnorm > 0),
+                      wnorm / rnorm, 1.0)
+    return w - lr * trust * r, m, v
+
+
+_BIG = 1e30  # "no clipping" sentinel so kernels stay clip-shape stable
+
+
+class _KernelOpt(Optimizer):
+    def _clipval(self):
+        return self.clip_gradient if self.clip_gradient else _BIG
+
+
+@register("sgd")
+class SGD(_KernelOpt):
+    """SGD with momentum (parity: optimizer/sgd.py → sgd_update /
+    sgd_mom_update / mp_sgd_* kernels)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return NDArray(jnp.zeros(weight.shape, weight.dtype))
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        w, g = _to_jax(weight), _to_jax(grad)
+        if self.momentum == 0.0:
+            weight._rebind(_sgd_kernel(w, g, lr, wd, self.rescale_grad,
+                                       self._clipval()))
+        else:
+            new_w, new_m = _sgd_mom_kernel(
+                w, g, _to_jax(state), lr, wd, self.momentum,
+                self.rescale_grad, self._clipval())
+            weight._rebind(new_w)
+            state._rebind(new_m)
+
+    fused_supported = True
+
+    def init_state_arrays(self, w):
+        return (jnp.zeros_like(w),) if self.momentum != 0.0 else ()
+
+    def apply_arrays(self, w, g, states, lr, wd, t):
+        # NB: lr/wd arrive as STRONG f32 scalars; every kernel must cast its
+        # outputs back to the input dtypes or bf16 params silently drift to
+        # f32 (recompile + full-precision model — a real perf bug caught on
+        # hardware)
+        g = _clip(g * self.rescale_grad, self.clip_gradient).astype(w.dtype)
+        if self.momentum == 0.0:
+            return (w - lr * (g + wd * w)).astype(w.dtype), ()
+        mom = (self.momentum * states[0] - lr * (g + wd * w)).astype(w.dtype)
+        return (w + mom).astype(w.dtype), (mom,)
+
+
+@register("nag")
+class NAG(_KernelOpt):
+    """Nesterov accelerated SGD (parity: optimizer/nag.py)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        new_w, new_m = _nag_kernel(_to_jax(weight), _to_jax(grad),
+                                   _to_jax(state), lr, wd, self.momentum,
+                                   self.rescale_grad, self._clipval())
+        weight._rebind(new_w)
+        state._rebind(new_m)
+
+
+@register("adam")
+class Adam(_KernelOpt):
+    """Adam (parity: optimizer/adam.py → adam_update kernel)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros(weight.shape, weight.dtype)),
+                NDArray(jnp.zeros(weight.shape, weight.dtype)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._t(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        lr_t = lr * math.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        m, v = state
+        new_w, new_m, new_v = _adam_kernel(
+            _to_jax(weight), _to_jax(grad), _to_jax(m), _to_jax(v), lr_t, wd,
+            self.beta1, self.beta2, self.epsilon, self.rescale_grad,
+            self._clipval())
+        weight._rebind(new_w)
+        m._rebind(new_m)
+        v._rebind(new_v)
+
+    fused_supported = True
+
+    def init_state_arrays(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def apply_arrays(self, w, g, states, lr, wd, t):
+        m, v = states
+        tf = t.astype(jnp.float32)
+        lr_t = lr * jnp.sqrt(1 - jnp.power(self.beta2, tf)) / \
+            (1 - jnp.power(self.beta1, tf))
+        wdt = w.dtype
+        g = _clip(g * self.rescale_grad, self.clip_gradient).astype(wdt)
+        g = (g + wd * w).astype(wdt)
+        m = (self.beta1 * m + (1 - self.beta1) * g).astype(wdt)
+        v = (self.beta2 * v + (1 - self.beta2) * jnp.square(g)).astype(wdt)
+        w = (w - lr_t * m / (jnp.sqrt(v) + self.epsilon)).astype(wdt)
+        return w, (m, v)
+
+
+@register("adamw")
+class AdamW(_KernelOpt):
+    """AdamW with decoupled weight decay (parity: contrib adamw.cc;
+    `eta` is the schedule multiplier as in the reference's mp_adamw)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, correct_bias=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.correct_bias = correct_bias
+        self.eta = 1.0
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros(weight.shape, weight.dtype)),
+                NDArray(jnp.zeros(weight.shape, weight.dtype)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._t(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        bc1 = 1 - self.beta1 ** t if self.correct_bias else 1.0
+        bc2 = 1 - self.beta2 ** t if self.correct_bias else 1.0
+        m, v = state
+        new_w, new_m, new_v = _adamw_kernel(
+            _to_jax(weight), _to_jax(grad), _to_jax(m), _to_jax(v), lr,
+            self.eta, wd, self.beta1, self.beta2, self.epsilon, bc1, bc2,
+            self.rescale_grad, self._clipval())
+        weight._rebind(new_w)
+        m._rebind(new_m)
+        v._rebind(new_v)
+
+    fused_supported = True
+
+    def init_state_arrays(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def apply_arrays(self, w, g, states, lr, wd, t):
+        m, v = states
+        tf = t.astype(jnp.float32)
+        bc1 = 1 - jnp.power(self.beta1, tf) if self.correct_bias else 1.0
+        bc2 = 1 - jnp.power(self.beta2, tf) if self.correct_bias else 1.0
+        wdt = w.dtype
+        g = _clip(g * self.rescale_grad, self.clip_gradient).astype(wdt)
+        m = (self.beta1 * m + (1 - self.beta1) * g).astype(wdt)
+        v = (self.beta2 * v + (1 - self.beta2) * jnp.square(g)).astype(wdt)
+        mhat = m / bc1
+        vhat = v / bc2
+        w = (w - self.eta * (lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+                             + wd * w)).astype(wdt)
+        return w, (m, v)
+
+
+@register("adagrad")
+class Adagrad(_KernelOpt):
+    def __init__(self, learning_rate=0.01, eps=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        new_w, new_h = _adagrad_kernel(
+            _to_jax(weight), _to_jax(grad), _to_jax(state), lr, wd,
+            self.float_stable_eps, self.rescale_grad, self._clipval())
+        weight._rebind(new_w)
+        state._rebind(new_h)
+
+
+@register("adadelta")
+class AdaDelta(_KernelOpt):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros(weight.shape, weight.dtype)),
+                NDArray(jnp.zeros(weight.shape, weight.dtype)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_d = state
+        new_w, ng, ndlt = _adadelta_kernel(
+            _to_jax(weight), _to_jax(grad), _to_jax(acc_g), _to_jax(acc_d),
+            self.rho, self.epsilon, wd, self.rescale_grad, self._clipval())
+        weight._rebind(new_w)
+        acc_g._rebind(ng)
+        acc_d._rebind(ndlt)
+
+
+@register("rmsprop")
+class RMSProp(_KernelOpt):
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.momentum = rho, momentum
+        self.epsilon, self.centered = epsilon, centered
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, weight.dtype))
+        if self.centered:
+            return (z(), z(), z())
+        return z()
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if self.centered:
+            n, gbar, mom = state
+            new_w, nn, ngbar, nmom = _rmsprop_center_kernel(
+                _to_jax(weight), _to_jax(grad), _to_jax(n), _to_jax(gbar),
+                _to_jax(mom), lr, wd, self.rho, self.momentum, self.epsilon,
+                self.rescale_grad, self._clipval())
+            weight._rebind(new_w)
+            n._rebind(nn)
+            gbar._rebind(ngbar)
+            mom._rebind(nmom)
+        else:
+            new_w, nn = _rmsprop_kernel(
+                _to_jax(weight), _to_jax(grad), _to_jax(state), lr, wd,
+                self.rho, self.epsilon, self.rescale_grad, self._clipval())
+            weight._rebind(new_w)
+            state._rebind(nn)
+
+
+@register("ftrl")
+class Ftrl(_KernelOpt):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros(weight.shape, weight.dtype)),
+                NDArray(jnp.zeros(weight.shape, weight.dtype)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        new_w, nz, nn = _ftrl_kernel(
+            _to_jax(weight), _to_jax(grad), _to_jax(z), _to_jax(n), lr, wd,
+            self.lamda1, self.beta, self.rescale_grad, self._clipval())
+        weight._rebind(new_w)
+        z._rebind(nz)
+        n._rebind(nn)
+
+
+@register("signum")
+class Signum(_KernelOpt):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.wd_lh = momentum, wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return NDArray(jnp.zeros(weight.shape, weight.dtype))
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mom = state if state is not None else \
+            NDArray(jnp.zeros(weight.shape, weight.dtype))
+        new_w, nm = _signum_kernel(
+            _to_jax(weight), _to_jax(grad), _to_jax(mom), lr, wd,
+            self.momentum, self.wd_lh, self.rescale_grad, self._clipval())
+        weight._rebind(new_w)
+        if state is not None:
+            state._rebind(nm)
+
+
+@register("lars")
+class LARS(SGD):
+    """Layer-wise adaptive rate scaling (parity: contrib multi_lars.cc)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, momentum=momentum,
+                         **kwargs)
+        self.eta, self.epsilon = eta, epsilon
+
+    def update(self, index, weight, grad, state):
+        wd = self._get_wd(index)
+        g, wnorm, gnorm = _lars_phase(_to_jax(weight), _to_jax(grad),
+                                      self.rescale_grad, self._clipval(), wd)
+        wn, gn = float(wnorm), float(gnorm)
+        ratio = self.eta * wn / (gn + wd * wn + self.epsilon) \
+            if wn > 0 and gn > 0 else 1.0
+        saved_lr = self._learning_rate
+        scaled = self._get_lr(index) * ratio
+        try:
+            if self.lr_scheduler is None:
+                self._learning_rate = scaled
+                super().update(index, weight, grad, state)
+            else:
+                # bypass property guard: scale via lr_mult
+                name = self.idx2name.get(index, index)
+                prev = self.lr_mult.get(name, 1.0)
+                self.lr_mult[name] = prev * ratio
+                try:
+                    super().update(index, weight, grad, state)
+                finally:
+                    self.lr_mult[name] = prev
+        finally:
+            self._learning_rate = saved_lr
+
+
+@register("lamb")
+class LAMB(_KernelOpt):
+    """LAMB for large-batch training (parity: contrib multi_lamb.cc)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound = lower_bound if lower_bound is not None else 0.0
+        self.upper_bound = upper_bound if upper_bound is not None else _BIG
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros(weight.shape, weight.dtype)),
+                NDArray(jnp.zeros(weight.shape, weight.dtype)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._t(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        bc1 = 1 - self.beta1 ** t if self.bias_correction else 1.0
+        bc2 = 1 - self.beta2 ** t if self.bias_correction else 1.0
+        m, v = state
+        new_w, nm, nv = _lamb_kernel(
+            _to_jax(weight), _to_jax(grad), _to_jax(m), _to_jax(v), lr, wd,
+            self.beta1, self.beta2, self.epsilon, bc1, bc2,
+            self.lower_bound, self.upper_bound, self.rescale_grad,
+            self._clipval())
+        weight._rebind(new_w)
+        m._rebind(nm)
+        v._rebind(nv)
+
+    fused_supported = True
+
+    def init_state_arrays(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+    def apply_arrays(self, w, g, states, lr, wd, t):
+        m, v = states
+        tf = t.astype(jnp.float32)
+        bc1 = 1 - jnp.power(self.beta1, tf) if self.bias_correction else 1.0
+        bc2 = 1 - jnp.power(self.beta2, tf) if self.bias_correction else 1.0
+        wdt = w.dtype
+        g = _clip(g * self.rescale_grad, self.clip_gradient).astype(wdt)
+        m = (self.beta1 * m + (1 - self.beta1) * g).astype(wdt)
+        v = (self.beta2 * v + (1 - self.beta2) * jnp.square(g)).astype(wdt)
+        mhat = m / bc1
+        vhat = v / bc2
+        r = mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * w
+        w32 = w.astype(jnp.float32)
+        wnorm = jnp.clip(jnp.linalg.norm(w32.ravel()),
+                         self.lower_bound, self.upper_bound)
+        rnorm = jnp.linalg.norm(r.astype(jnp.float32).ravel())
+        trust = jnp.where(jnp.logical_and(wnorm > 0, rnorm > 0),
+                          wnorm / rnorm, 1.0)
+        return (w - lr * trust * r).astype(wdt), (m, v)
+
+
+@register("dcasgd")
+class DCASGD(_KernelOpt):
+    """Delay-compensated async SGD (parity: optimizer/dcasgd.py). Included
+    for API surface; async PS training itself is de-scoped (SURVEY §5.8)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.lamda = momentum, lamda
+
+    def create_state(self, index, weight):
+        return (NDArray(jnp.zeros(weight.shape, weight.dtype))
+                if self.momentum != 0 else None,
+                NDArray(_to_jax(weight)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mom, prev_w = state
+        w, g = _to_jax(weight), _to_jax(grad)
+        g = _clip(g * self.rescale_grad, self.clip_gradient).astype(w.dtype)
+        g = g + wd * w + self.lamda * g * g * (w - _to_jax(prev_w))
+        if mom is None:
+            new_w = w - lr * g
+        else:
+            nm = self.momentum * _to_jax(mom) - lr * g
+            mom._rebind(nm)
+            new_w = w + nm
+        prev_w._rebind(w)
+        weight._rebind(new_w)
+
+
+@register("sgld")
+class SGLD(_KernelOpt):
+    """Stochastic gradient Langevin dynamics (parity: optimizer/sgld.py)."""
+
+    def update(self, index, weight, grad, state):
+        from .. import rng as _rngmod
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        w, g = _to_jax(weight), _to_jax(grad)
+        g = _clip(g * self.rescale_grad, self.clip_gradient).astype(w.dtype)
+        noise = jax.random.normal(_rngmod.next_key(), w.shape, w.dtype) * \
+            jnp.sqrt(lr)
+        weight._rebind(w - lr / 2 * (g + wd * w) + noise)
+
+
+class Test(Optimizer):
+    """Trivial optimizer used by tests (parity: optimizer.Test)."""
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        weight._rebind(_to_jax(weight) - self.learning_rate *
+                       _to_jax(grad) * self.rescale_grad)

@@ -1,0 +1,69 @@
+"""Updater: optimizer + per-key state store.
+
+Reference parity: python/mxnet/optimizer/updater.py — the callable handed to
+KVStore (`kv.set_optimizer` → server-side updates) and used directly by
+Trainer when update_on_kvstore=False. Owns state creation on first sight of
+a key and (de)serialization of optimizer states.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+
+import numpy as _np
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray
+
+
+class Updater:
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        """Serialize states (parity: Updater.get_states; pickled numpy)."""
+
+        def conv(s):
+            if isinstance(s, NDArray):
+                return ("nd", s.asnumpy())
+            if isinstance(s, (tuple, list)):
+                return ("tup", tuple(conv(x) for x in s))
+            return ("raw", s)
+
+        payload = {k: conv(v) for k, v in self.states.items()}
+        if dump_optimizer:
+            payload["__optimizer__"] = ("opt", pickle.dumps(self.optimizer))
+        buf = io.BytesIO()
+        pickle.dump(payload, buf)
+        return buf.getvalue()
+
+    def set_states(self, states):
+        payload = pickle.loads(states)
+        opt = payload.pop("__optimizer__", None)
+        if opt is not None:
+            self.optimizer = pickle.loads(opt[1])
+
+        def unconv(s):
+            kind, val = s
+            if kind == "nd":
+                return NDArray(jnp.asarray(val))
+            if kind == "tup":
+                return tuple(unconv(x) for x in val)
+            return val
+
+        self.states = {k: unconv(v) for k, v in payload.items()}
+        self.states_synced = {k: False for k in self.states}
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

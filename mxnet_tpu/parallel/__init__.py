@@ -1,0 +1,13 @@
+"""Parallelism over a device mesh (TPU-native; replaces KVStore/NCCL).
+
+SURVEY.md §2.4/§5.8: all the reference's parallel flavors (and the ones it
+lacks: tp/pp/sp/ep/ZeRO) become sharding specifications over one
+jax.sharding.Mesh here, with XLA emitting the collectives.
+"""
+from .mesh import (  # noqa: F401
+    AXIS_DP, AXIS_EP, AXIS_FSDP, AXIS_PP, AXIS_SP, AXIS_TP, Mesh,
+    NamedSharding, PartitionSpec, current_mesh, make_mesh, mesh_scope,
+    named_sharding, set_default_mesh)
+from .rules import (  # noqa: F401
+    ShardingRules, apply_sharding_rules, megatron_dense_rules)
+from .step import EvalStep, TrainStep  # noqa: F401

@@ -1,0 +1,83 @@
+"""Sharding rules: pattern → PartitionSpec assignment over a Block's params.
+
+Reference parity: none — the reference's only model parallelism is manual
+group2ctx device assignment (SURVEY.md §2.4 'Model parallelism (manual)').
+The TPU-native replacement: declarative regex rules mapping parameter paths
+to PartitionSpecs, applied once; XLA's SPMD partitioner does the rest. This
+is how tp/fsdp/ep sharding attaches to existing Gluon models with no model
+code changes.
+"""
+from __future__ import annotations
+
+import re
+
+from ..base import MXNetError
+from .mesh import PartitionSpec
+
+__all__ = ["ShardingRules", "apply_sharding_rules", "megatron_dense_rules"]
+
+
+class ShardingRules:
+    """Ordered (regex, PartitionSpec) list; first match wins."""
+
+    def __init__(self, rules=None, default=None):
+        self.rules = [(re.compile(p), spec) for p, spec in (rules or [])]
+        self.default = default  # None = replicated
+
+    def add(self, pattern, spec):
+        self.rules.append((re.compile(pattern), spec))
+        return self
+
+    def spec_for(self, name, shape=None):
+        for pat, spec in self.rules:
+            if pat.search(name):
+                return spec
+        return self.default
+
+    def __iter__(self):
+        return iter(self.rules)
+
+
+def apply_sharding_rules(net_or_params, rules):
+    """Set `param.sharding` for every matching parameter.
+
+    net_or_params: a Block or a ParameterDict. Validates that sharded dims
+    exist in the param's shape (a spec longer than the rank is an error)."""
+    params = net_or_params
+    if hasattr(params, "collect_params"):
+        params = params.collect_params()
+    for name, p in params.items():
+        spec = rules.spec_for(name, p.shape)
+        if spec is None:
+            continue
+        if p.shape is not None and len(spec) > len(p.shape):
+            raise MXNetError(
+                f"sharding spec {spec} longer than rank of {name} "
+                f"{p.shape}")
+        p.sharding = spec
+    return params
+
+
+def megatron_dense_rules(tp_axis="tp", fsdp_axis=None):
+    """Megatron-style tensor parallelism for transformer blocks built from
+    Dense layers: column-parallel QKV/FFN-in (out-dim sharded), row-parallel
+    proj/FFN-out (in-dim sharded). Dense weights here are (out, in) —
+    reference FullyConnected convention.
+
+    Combined with fsdp_axis, remaining dims shard ZeRO-style."""
+    col = PartitionSpec(tp_axis, fsdp_axis)
+    row = PartitionSpec(fsdp_axis, tp_axis)
+    rules = ShardingRules()
+    # attention QKV + first FFN layer: column parallel
+    rules.add(r"(query|key|value|qkv|attn_in|ffn?_?1|intermediate|fc1)"
+              r"\.weight$", col)
+    # attention out-proj + second FFN layer: row parallel
+    rules.add(r"(proj|attn_out|out_proj|ffn?_?2|output|fc2)\.weight$", row)
+    # column-parallel biases follow the out dim
+    rules.add(r"(query|key|value|qkv|attn_in|ffn?_?1|intermediate|fc1)"
+              r"\.bias$", PartitionSpec(tp_axis))
+    # embeddings: shard vocab dim over tp
+    rules.add(r"embed\w*\.weight$", PartitionSpec(tp_axis, fsdp_axis))
+    if fsdp_axis is not None:
+        rules.default = None  # leave rest replicated; fsdp via explicit specs
+    return rules

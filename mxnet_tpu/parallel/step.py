@@ -1,0 +1,292 @@
+"""Fused, sharded training step.
+
+This is the TPU-native performance path (SURVEY.md §7.2 M6/M7): where the
+reference runs forward (CachedOp) → backward (engine) → kvstore pushpull →
+per-weight optimizer kernels as thousands of engine ops, here the WHOLE
+training step — forward, loss, backward, gradient reduction, optimizer —
+compiles into ONE XLA program over the device mesh:
+
+  * parameters/optimizer states enter sharded per their PartitionSpec and
+    are donated (buffer reuse = the reference's in-place engine updates);
+  * the batch enters sharded over the "dp"/"fsdp" (+"sp") axes; gradient
+    all-reduce is NOT written anywhere — XLA inserts the collectives that
+    the sharding math requires (psum over dp for replicated params,
+    reduce-scatter for fsdp-sharded params), executing them on ICI;
+  * comm/compute overlap (the reference's priority-scheduled kvstore
+    pushes, SURVEY.md §3.2c) falls out of XLA's latency-hiding scheduler.
+
+Gluon semantics preserved: works on any initialized (Hybrid)Block, the
+loss is a gluon loss block, BatchNorm running stats update through the
+trace side-channel, dropout draws from a per-step key.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd, rng as _rng
+from ..base import MXNetError
+from ..gluon.block import _trace_channel
+from ..ndarray.ndarray import NDArray
+from .mesh import PartitionSpec, current_mesh, mesh_scope, named_sharding
+
+__all__ = ["TrainStep", "EvalStep"]
+
+
+def _spec_or_replicated(spec):
+    return spec if spec is not None else PartitionSpec()
+
+
+class TrainStep:
+    """Compile net+loss+optimizer into one sharded step program.
+
+    Usage:
+        step = TrainStep(net, loss_fn, optimizer, mesh=mesh,
+                         batch_specs=(P("dp"), P("dp")))
+        loss = step(data, label)          # one fused device step
+        step.sync_params()                # reflect weights into the Block
+    """
+
+    def __init__(self, net, loss_fn, optimizer, mesh=None, batch_specs=None,
+                 donate=True, loss_reduce="mean", n_net_inputs=1):
+        self.net = net
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh if mesh is not None else current_mesh()
+        self.batch_specs = batch_specs
+        self.donate = donate
+        self.loss_reduce = loss_reduce
+        self.n_net_inputs = n_net_inputs  # batch[:n] → net, batch[n:] → loss
+        if not optimizer.fused_supported:
+            raise MXNetError(
+                f"{type(optimizer).__name__} has no functional path for the "
+                "fused step; use SGD/Adam/AdamW/LAMB or the eager Trainer")
+        params = net.collect_params()
+        self._params = [p for p in params.values()]
+        self._trainable = [p.grad_req != "null" for p in self._params]
+        for p in self._params:
+            if p._data is None:
+                raise MXNetError(
+                    f"parameter {p.name} not initialized; run one forward "
+                    "or set shapes before building TrainStep")
+        # own copies: step buffers are DONATED to XLA each call, and the
+        # source NDArrays may be aliased elsewhere (donating a shared
+        # buffer would delete it under the other holder's feet)
+        self._param_arrays = [jnp.copy(p.data()._data)
+                              for p in self._params]
+        self._opt_states = tuple(
+            optimizer.init_state_arrays(a) if tr else ()
+            for a, tr in zip(self._param_arrays, self._trainable))
+        self._t = jnp.zeros((), jnp.int32)
+        self._host_t = 0
+        self._base_key = None
+        self._lr_cache = None
+        self._wd_cache = None
+        self._jitted = None
+        self._meta = {}
+        if self.mesh is not None:
+            self._place_sharded()
+
+    # -- sharding placement ------------------------------------------------
+    def _place_sharded(self):
+        with mesh_scope(self.mesh):
+            placed = []
+            for p, a in zip(self._params, self._param_arrays):
+                s = named_sharding(_spec_or_replicated(p.sharding))
+                placed.append(jax.device_put(a, s))
+            self._param_arrays = placed
+            self._opt_states = tuple(
+                tuple(jax.device_put(
+                    s, named_sharding(_spec_or_replicated(p.sharding)))
+                    for s in states)
+                for p, states in zip(self._params, self._opt_states))
+
+    def param_sharding_specs(self):
+        return [_spec_or_replicated(p.sharding) for p in self._params]
+
+    # -- build -------------------------------------------------------------
+    def _build(self, n_batch):
+        net, loss_fn, opt = self.net, self.loss_fn, self.optimizer
+        params = self._params
+        trainable = self._trainable
+        reduce = self.loss_reduce
+        meta = self._meta
+
+        def forward_loss(param_datas, batch_datas, key):
+            saved = [p._data for p in params]
+            _trace_channel.push_frame()
+            try:
+                for p, d in zip(params, param_datas):
+                    arr = NDArray(d)
+                    arr._grad_req = "null"
+                    p._data = arr
+                args = [NDArray(d) for d in batch_datas]
+                n_net_in = self.n_net_inputs
+                with autograd._Scope(False, True), _rng.key_scope(key):
+                    out = net.forward(*args[:n_net_in])
+                    outs = out if isinstance(out, tuple) else (out,)
+                    loss = loss_fn(*outs, *args[n_net_in:])
+            finally:
+                updates = _trace_channel.pop_frame()
+                for p, d in zip(params, saved):
+                    p._data = d
+            meta["state_updates"] = updates
+            ldata = loss._data if isinstance(loss, NDArray) else loss
+            if reduce == "mean":
+                ldata = jnp.mean(ldata)
+            elif reduce == "sum":
+                ldata = jnp.sum(ldata)
+            aux = tuple(u for _, u in updates)
+            return ldata.astype(jnp.float32), aux
+
+        def step_fn(param_datas, opt_states, t, base_key, lr, wd,
+                    *batch_datas):
+            t = t + 1
+            # per-step randomness derived INSIDE the program (no host RNG
+            # round-trip per step; the reference's engine-managed Philox
+            # streams achieve the same "no host in the loop" property)
+            key = jax.random.fold_in(base_key, t)
+
+            def loss_of(trainable_params):
+                full = []
+                it = iter(trainable_params)
+                for base, tr in zip(param_datas, trainable):
+                    full.append(next(it) if tr else base)
+                return forward_loss(tuple(full), batch_datas, key)
+
+            tparams = tuple(d for d, tr in zip(param_datas, trainable) if tr)
+            (loss, aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(tparams)
+
+            new_params, new_states = [], []
+            git = iter(grads)
+            for d, st, tr in zip(param_datas, opt_states, trainable):
+                if not tr:
+                    new_params.append(d)
+                    new_states.append(st)
+                    continue
+                g = next(git)
+                nw, ns = opt.apply_arrays(d, g, st, lr, wd, t)
+                new_params.append(nw)
+                new_states.append(ns)
+            return tuple(new_params), tuple(new_states), t, loss, aux
+
+        donate = (0, 1, 2) if self.donate else ()
+        if self.mesh is not None:
+            with mesh_scope(self.mesh):
+                pspecs = [named_sharding(s)
+                          for s in self.param_sharding_specs()]
+                sspecs = tuple(
+                    tuple(pspecs[i] for _ in st)
+                    for i, st in enumerate(self._opt_states))
+                repl = named_sharding(PartitionSpec())
+                bspecs = tuple(
+                    named_sharding(s) for s in (
+                        self.batch_specs or
+                        [PartitionSpec("dp")] * n_batch))
+                jitted = jax.jit(
+                    step_fn,
+                    in_shardings=(tuple(pspecs), sspecs, repl, repl, repl,
+                                  repl) + bspecs,
+                    donate_argnums=donate)
+        else:
+            jitted = jax.jit(step_fn, donate_argnums=donate)
+        return jitted
+
+    # -- run ---------------------------------------------------------------
+    def __call__(self, *batch):
+        datas = tuple(b._data if isinstance(b, NDArray) else jnp.asarray(b)
+                      for b in batch)
+        if self._jitted is None:
+            self._jitted = self._build(len(datas))
+        if self._base_key is None:
+            self._base_key = _rng.next_key()
+        # cache device scalars for lr/wd — refresh only when the host value
+        # changes (schedulers); avoids 2 H2D transfers per step
+        lr_v = float(self.optimizer.learning_rate)
+        wd_v = float(self.optimizer.wd)
+        if self._lr_cache is None or self._lr_cache[0] != lr_v:
+            self._lr_cache = (lr_v, jnp.asarray(lr_v, jnp.float32))
+        if self._wd_cache is None or self._wd_cache[0] != wd_v:
+            self._wd_cache = (wd_v, jnp.asarray(wd_v, jnp.float32))
+        key, lr, wd = self._base_key, self._lr_cache[1], self._wd_cache[1]
+        if self.mesh is not None:
+            with mesh_scope(self.mesh):
+                bspecs = (self.batch_specs or
+                          [PartitionSpec("dp")] * len(datas))
+                datas = tuple(
+                    jax.device_put(d, named_sharding(s))
+                    for d, s in zip(datas, bspecs))
+        out = self._jitted(tuple(self._param_arrays), self._opt_states,
+                           self._t, key, lr, wd, *datas)
+        self._param_arrays, self._opt_states, self._t, loss, aux = out
+        self._host_t += 1  # mirror of t — no device fetch in the hot loop
+        self.optimizer.num_update = self._host_t
+        # mutable layer state (BN stats) written back eagerly
+        for (p, _), new in zip(self._meta.get("state_updates", ()), aux):
+            p._data._rebind(new)
+        return NDArray(loss)
+
+    def sync_params(self):
+        """Write the step's device arrays back into the Block's Parameters
+        (so save_parameters / eager eval see current weights)."""
+        for p, a in zip(self._params, self._param_arrays):
+            p._data._rebind(a)
+
+    @property
+    def step_count(self):
+        return self._host_t
+
+
+class EvalStep:
+    """Jitted inference step over the mesh (forward only)."""
+
+    def __init__(self, net, mesh=None, batch_specs=None):
+        self.net = net
+        self.mesh = mesh if mesh is not None else current_mesh()
+        self.batch_specs = batch_specs
+        self._params = list(net.collect_params().values())
+        self._jitted = None
+
+    def _build(self, n_batch):
+        net, params = self.net, self._params
+
+        def fwd(param_datas, key, *batch_datas):
+            saved = [p._data for p in params]
+            _trace_channel.push_frame()
+            try:
+                for p, d in zip(params, param_datas):
+                    arr = NDArray(d)
+                    arr._grad_req = "null"
+                    p._data = arr
+                args = [NDArray(d) for d in batch_datas]
+                with autograd._Scope(False, False), _rng.key_scope(key):
+                    out = net.forward(*args)
+            finally:
+                _trace_channel.pop_frame()
+                for p, d in zip(params, saved):
+                    p._data = d
+            outs = out if isinstance(out, tuple) else (out,)
+            return tuple(o._data for o in outs)
+
+        if self.mesh is not None:
+            with mesh_scope(self.mesh):
+                repl = named_sharding(PartitionSpec())
+                pspecs = tuple(
+                    named_sharding(_spec_or_replicated(p.sharding))
+                    for p in params)
+                bspecs = tuple(named_sharding(s) for s in (
+                    self.batch_specs or [PartitionSpec("dp")] * n_batch))
+                return jax.jit(fwd, in_shardings=(pspecs, repl) + bspecs)
+        return jax.jit(fwd)
+
+    def __call__(self, *batch):
+        datas = tuple(b._data if isinstance(b, NDArray) else jnp.asarray(b)
+                      for b in batch)
+        if self._jitted is None:
+            self._jitted = self._build(len(datas))
+        key = _rng.next_key()
+        param_datas = tuple(p.data()._data for p in self._params)
+        outs = self._jitted(param_datas, key, *datas)
+        res = tuple(NDArray(o) for o in outs)
+        return res[0] if len(res) == 1 else res

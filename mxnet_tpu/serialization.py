@@ -144,10 +144,13 @@ def _read_legacy_ndarray(r: _Reader):
         version = r.u32()
         if version > 1:
             stype = r.i32()
-            if stype not in (-1, 1):  # kDefaultStorage markers
+            # NDArrayStorageType: kUndefinedStorage=-1, kDefaultStorage=0,
+            # kRowSparseStorage=1, kCSRStorage=2
+            if stype not in (-1, 0):
                 raise MXNetError(
-                    "legacy .params contains a sparse NDArray; sparse import "
-                    "is not supported on TPU (dense-only)")
+                    "legacy .params contains a sparse NDArray (stype="
+                    f"{stype}); sparse import is not supported on TPU "
+                    "(dense-only)")
         ndim = r.u32()
         shape = r.i64s(ndim)
     else:

@@ -16,6 +16,17 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# jax may be PRE-IMPORTED at interpreter start (site hooks) with the env's
+# JAX_PLATFORMS (e.g. a TPU tunnel); env edits alone are then ignored.
+# Backends initialize lazily, so forcing the config here still wins as long
+# as no jax computation ran yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, (
+    "conftest could not force the 8-device virtual CPU mesh; "
+    f"got {jax.devices()} — was a backend already initialized?")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,0 +1,161 @@
+"""Data pipeline tests (modeled on test_gluon_data.py / test_recordio.py /
+test_io.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import data as gdata
+from mxnet_tpu.gluon.data.vision import transforms
+from mxnet_tpu.io import (IRHeader, MXIndexedRecordIO, MXRecordIO,
+                          NDArrayIter, pack, unpack)
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = MXRecordIO(path, "w")
+    records = [b"hello", b"world" * 100, b"", b"x"]
+    for r in records:
+        w.write(r)
+    w.close()
+    r = MXRecordIO(path, "r")
+    for expect in records:
+        assert r.read() == expect
+    assert r.read() is None
+    r.close()
+
+
+def test_recordio_magic_collision(tmp_path):
+    """Payload containing the magic splits into multi-part records."""
+    import struct
+    path = str(tmp_path / "m.rec")
+    payload = b"A" * 7 + struct.pack("<I", 0xCED7230A) + b"B" * 9
+    w = MXRecordIO(path, "w")
+    w.write(payload)
+    w.close()
+    r = MXRecordIO(path, "r")
+    assert r.read() == payload
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    rec = str(tmp_path / "x.rec")
+    idx = str(tmp_path / "x.idx")
+    w = MXIndexedRecordIO(idx, rec, "w")
+    for i in range(10):
+        w.write_idx(i, f"record-{i}".encode())
+    w.close()
+    r = MXIndexedRecordIO(idx, rec, "r")
+    assert r.read_idx(7) == b"record-7"
+    assert r.read_idx(0) == b"record-0"
+    assert len(r.keys) == 10
+    r.close()
+
+
+def test_irheader_pack_unpack():
+    h = IRHeader(0, 3.0, 42, 0)
+    s = pack(h, b"payload")
+    h2, data = unpack(s)
+    assert h2.label == 3.0 and h2.id == 42
+    assert data == b"payload"
+    # multi-label
+    h = IRHeader(0, [1.0, 2.0, 3.0], 7, 0)
+    h2, data = unpack(pack(h, b"img"))
+    np.testing.assert_allclose(h2.label, [1.0, 2.0, 3.0])
+    assert data == b"img"
+
+
+def test_array_dataset_and_loader():
+    X = np.random.rand(25, 4).astype(np.float32)
+    Y = np.arange(25, dtype=np.int32)
+    ds = gdata.ArrayDataset(X, Y)
+    assert len(ds) == 25
+    loader = gdata.DataLoader(ds, batch_size=8, last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 4
+    xb, yb = batches[0]
+    assert xb.shape == (8, 4)
+    assert yb.shape == (8,)
+    np.testing.assert_allclose(batches[0][0].asnumpy(), X[:8])
+    assert batches[-1][0].shape == (1, 4)
+
+
+def test_loader_discard_and_shuffle():
+    X = np.arange(10, dtype=np.float32)
+    ds = gdata.ArrayDataset(X)
+    loader = gdata.DataLoader(ds, batch_size=4, last_batch="discard")
+    assert len(list(loader)) == 2
+    loader = gdata.DataLoader(ds, batch_size=4, shuffle=True)
+    seen = np.sort(np.concatenate([b.asnumpy() for b in loader]))
+    np.testing.assert_allclose(seen, X)
+
+
+def test_loader_multiworker():
+    X = np.random.rand(30, 3).astype(np.float32)
+    ds = gdata.ArrayDataset(X, np.arange(30, dtype=np.int32))
+    loader = gdata.DataLoader(ds, batch_size=10, num_workers=2)
+    got = sorted(int(y) for _, yb in loader for y in yb.asnumpy())
+    assert got == list(range(30))
+
+
+def test_dataset_transform_and_shard():
+    ds = gdata.SimpleDataset(list(range(20)))
+    t = ds.transform(lambda x: x * 2)
+    assert t[3] == 6
+    s = ds.shard(4, 1)
+    assert list(s[i] for i in range(len(s))) == [1, 5, 9, 13, 17]
+    tk = ds.take(5)
+    assert len(tk) == 5
+
+
+def test_transforms():
+    img = mx.nd.array(np.random.randint(0, 255, (32, 24, 3)), dtype="uint8")
+    t = transforms.ToTensor()(img)
+    assert t.shape == (3, 32, 24)
+    assert t.dtype == np.float32
+    assert float(t.max().asscalar()) <= 1.0
+
+    n = transforms.Normalize(mean=(0.5, 0.5, 0.5), std=(0.2, 0.2, 0.2))(t)
+    assert n.shape == (3, 32, 24)
+
+    r = transforms.Resize((16, 8))(img)   # (w, h)
+    assert r.shape == (8, 16, 3)
+
+    c = transforms.CenterCrop((10, 12))(img)
+    assert c.shape == (12, 10, 3)
+
+    rc = transforms.RandomResizedCrop(16)(img)
+    assert rc.shape == (16, 16, 3)
+
+    comp = transforms.Compose([transforms.Resize(16), transforms.ToTensor()])
+    out = comp(img)
+    assert out.shape == (3, 16, 16)
+
+
+def test_image_record_dataset(tmp_path):
+    """Write a small image RecordIO then read through ImageRecordDataset."""
+    from mxnet_tpu.io.recordio import pack_img
+    rec = str(tmp_path / "img.rec")
+    idx = str(tmp_path / "img.idx")
+    w = MXIndexedRecordIO(idx, rec, "w")
+    for i in range(4):
+        img = np.random.randint(0, 255, (8, 8, 3), np.uint8)
+        w.write_idx(i, pack_img(IRHeader(0, float(i), i, 0), img))
+    w.close()
+    ds = gdata.vision.ImageRecordDataset(rec)
+    assert len(ds) == 4
+    img, label = ds[2]
+    assert img.shape == (8, 8, 3)
+    assert label == 2.0
+
+
+def test_ndarray_iter():
+    X = np.random.rand(10, 3).astype(np.float32)
+    Y = np.arange(10, dtype=np.float32)
+    it = NDArrayIter(X, Y, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 3

@@ -1,0 +1,126 @@
+"""RNN layer/cell tests (modeled on tests/python/unittest/test_gluon_rnn.py:
+cell-vs-fused-layer agreement, bidirectional shapes, unroll)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import rnn
+from mxnet_tpu.ops import nn as opnn
+
+
+def test_lstm_shapes():
+    layer = rnn.LSTM(16, num_layers=2)
+    layer.initialize()
+    x = mx.nd.array(np.random.rand(5, 3, 8).astype(np.float32))  # TNC
+    y = layer(x)
+    assert y.shape == (5, 3, 16)
+    states = layer.begin_state(3)
+    y, new_states = layer(x, states)
+    assert y.shape == (5, 3, 16)
+    assert new_states[0].shape == (2, 3, 16)
+    assert new_states[1].shape == (2, 3, 16)
+
+
+def test_gru_ntc_layout():
+    layer = rnn.GRU(8, layout="NTC")
+    layer.initialize()
+    x = mx.nd.array(np.random.rand(3, 5, 4).astype(np.float32))
+    y = layer(x)
+    assert y.shape == (3, 5, 8)
+
+
+def test_bidirectional_lstm():
+    layer = rnn.LSTM(8, bidirectional=True)
+    layer.initialize()
+    x = mx.nd.array(np.random.rand(4, 2, 6).astype(np.float32))
+    y = layer(x)
+    assert y.shape == (4, 2, 16)  # 2*hidden
+
+
+def test_rnn_backward():
+    layer = rnn.LSTM(8)
+    layer.initialize()
+    x = mx.nd.array(np.random.rand(4, 2, 6).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = layer(x)
+        loss = (y * y).sum()
+    loss.backward()
+    assert x.grad is not None
+    assert not np.allclose(x.grad.asnumpy(), 0)
+    assert not np.allclose(layer.rnn_param.grad().asnumpy(), 0)
+
+
+def test_lstm_cell_matches_fused_layer():
+    """Cell stepped manually must equal the fused lax.scan layer when
+    loaded with the same flat parameter vector."""
+    np.random.seed(0)
+    H, I, T, B = 5, 3, 4, 2
+    layer = rnn.LSTM(H, input_size=I)
+    layer.initialize(mx.init.Uniform(0.2))
+    flat = layer.rnn_param.data().asnumpy()
+
+    cell = rnn.LSTMCell(H, input_size=I)
+    cell.initialize()
+    G = 4
+    o = 0
+    cell.i2h_weight.set_data(flat[o:o + G * H * I].reshape(G * H, I))
+    o += G * H * I
+    cell.h2h_weight.set_data(flat[o:o + G * H * H].reshape(G * H, H))
+    o += G * H * H
+    cell.i2h_bias.set_data(flat[o:o + G * H])
+    o += G * H
+    cell.h2h_bias.set_data(flat[o:o + G * H])
+
+    x = mx.nd.array(np.random.rand(T, B, I).astype(np.float32))
+    y_fused = layer(x).asnumpy()
+
+    states = cell.begin_state(B)
+    outs = []
+    for t in range(T):
+        out, states = cell(x[t], states)
+        outs.append(out.asnumpy())
+    y_cell = np.stack(outs, axis=0)
+    np.testing.assert_allclose(y_fused, y_cell, rtol=1e-5, atol=1e-6)
+
+
+def test_cell_unroll():
+    cell = rnn.GRUCell(8)
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(2, 6, 4).astype(np.float32))  # NTC
+    out, states = cell.unroll(6, x, layout="NTC")
+    assert out.shape == (2, 6, 8)
+    assert states[0].shape == (2, 8)
+
+
+def test_sequential_cell():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(8))
+    stack.add(rnn.LSTMCell(4))
+    stack.initialize()
+    x = mx.nd.array(np.random.rand(2, 6).astype(np.float32))
+    states = stack.begin_state(2)
+    out, new_states = stack(x, states)
+    assert out.shape == (2, 4)
+    assert len(new_states) == 4
+
+
+def test_residual_and_dropout_cells():
+    cell = rnn.ResidualCell(rnn.GRUCell(6, input_size=6))
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(3, 6).astype(np.float32))
+    out, _ = cell(x, cell.begin_state(3))
+    assert out.shape == (3, 6)
+
+    dc = rnn.DropoutCell(0.5)
+    out2, s = dc(x, [])
+    assert out2.shape == x.shape
+
+
+def test_bidirectional_cell_unroll():
+    bc = rnn.BidirectionalCell(rnn.LSTMCell(4), rnn.LSTMCell(4))
+    bc.initialize()
+    x = mx.nd.array(np.random.rand(2, 5, 3).astype(np.float32))
+    out, states = bc.unroll(5, x, layout="NTC")
+    assert out.shape == (2, 5, 8)
