@@ -9,23 +9,14 @@ Must set XLA flags BEFORE jax initialises, hence this runs at conftest
 import time.
 """
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# The forcing recipe is shared with the driver entry point; it must run
+# before jax initialises a backend, hence at conftest import time.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from __graft_entry__ import _force_virtual_cpu_mesh  # noqa: E402
 
-# jax may be PRE-IMPORTED at interpreter start (site hooks) with the env's
-# JAX_PLATFORMS (e.g. a TPU tunnel); env edits alone are then ignored.
-# Backends initialize lazily, so forcing the config here still wins as long
-# as no jax computation ran yet.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-assert len(jax.devices()) == 8, (
-    "conftest could not force the 8-device virtual CPU mesh; "
-    f"got {jax.devices()} — was a backend already initialized?")
+_force_virtual_cpu_mesh(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
