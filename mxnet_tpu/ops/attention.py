@@ -104,7 +104,12 @@ def flash_attention_data(q, k, v, mask=None, scale=None, causal=False,
         blk_max = jnp.max(logits, axis=-1)
         new_max = jnp.maximum(row_max, blk_max)
         correction = jnp.exp(row_max - new_max)
-        p = jnp.exp(logits - new_max[..., None])
+        # Rows with no valid key yet have new_max == NEG_INF, which would
+        # make exp(NEG_INF - NEG_INF) = 1 for every key; such rows must
+        # contribute zero so fully-masked queries yield zeros, not mean(V).
+        dead = new_max <= NEG_INF / 2
+        p = jnp.where(dead[..., None], 0.0,
+                      jnp.exp(logits - new_max[..., None]))
         row_sum = row_sum * correction + jnp.sum(p, axis=-1)
         acc = acc * correction[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
@@ -148,7 +153,10 @@ def ring_attention_data(q, k, v, axis_name, causal=False, scale=None,
         blk_max = jnp.max(logits, axis=-1)
         new_max = jnp.maximum(row_max, blk_max)
         corr = jnp.exp(row_max - new_max)
-        p = jnp.exp(logits - new_max[..., None])
+        # see flash_attention_data: fully-masked-so-far rows must emit 0
+        dead = new_max <= NEG_INF / 2
+        p = jnp.where(dead[..., None], 0.0,
+                      jnp.exp(logits - new_max[..., None]))
         row_sum = row_sum * corr + jnp.sum(p, axis=-1)
         acc = acc * corr[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))

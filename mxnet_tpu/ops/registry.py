@@ -90,7 +90,8 @@ def op(name=None, nodiff=False, register=True):
                 NDArray_holder["c"] = NDArray
             out = kwargs.pop("out", None)
             nd_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
-            arrs = [args[i] for i in nd_pos]
+            nd_keys = [k for k, v in kwargs.items() if isinstance(v, NDArray)]
+            arrs = [args[i] for i in nd_pos] + [kwargs[k] for k in nd_keys]
             if not arrs:
                 # creation-style op: run directly (no tape without tensor in)
                 res = fn(*args, **kwargs)
@@ -104,13 +105,18 @@ def op(name=None, nodiff=False, register=True):
                 return res
 
             if kwargs or len(nd_pos) != len(args):
-                sargs = args
+                n_pos = len(nd_pos)
 
-                def closed(*datas, _sargs=sargs, _kw=kwargs, _pos=tuple(nd_pos)):
+                def closed(*datas, _sargs=args, _kw=kwargs,
+                           _pos=tuple(nd_pos), _keys=tuple(nd_keys),
+                           _n=n_pos):
                     full = list(_sargs)
-                    for i, d in zip(_pos, datas):
+                    for i, d in zip(_pos, datas[:_n]):
                         full[i] = d
-                    return fn(*full, **_kw)
+                    kw = dict(_kw)
+                    for k, d in zip(_keys, datas[_n:]):
+                        kw[k] = d
+                    return fn(*full, **kw)
             else:
                 closed = fn
             return apply_op(name, closed, arrs, out=out, nodiff=nodiff)

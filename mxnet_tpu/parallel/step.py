@@ -64,6 +64,12 @@ class TrainStep:
         params = net.collect_params()
         self._params = [p for p in params.values()]
         self._trainable = [p.grad_req != "null" for p in self._params]
+        # per-parameter lr/wd multipliers are static. Parity with the eager
+        # Trainer: it sets optimizer.param_dict, so _get_lr/_get_wd use the
+        # Parameter's own lr_mult/wd_mult and never consult the name-keyed
+        # set_lr_mult/set_wd_mult dicts — mirror exactly that.
+        self._lr_mults = [p.lr_mult for p in self._params]
+        self._wd_mults = [p.wd_mult for p in self._params]
         for p in self._params:
             if p._data is None:
                 raise MXNetError(
@@ -160,13 +166,17 @@ class TrainStep:
 
             new_params, new_states = [], []
             git = iter(grads)
-            for d, st, tr in zip(param_datas, opt_states, trainable):
+            for d, st, tr, mlr, mwd in zip(param_datas, opt_states,
+                                           trainable, self._lr_mults,
+                                           self._wd_mults):
                 if not tr:
                     new_params.append(d)
                     new_states.append(st)
                     continue
                 g = next(git)
-                nw, ns = opt.apply_arrays(d, g, st, lr, wd, t)
+                plr = lr * mlr if mlr != 1.0 else lr
+                pwd = wd * mwd if mwd != 1.0 else wd
+                nw, ns = opt.apply_arrays(d, g, st, plr, pwd, t)
                 new_params.append(nw)
                 new_states.append(ns)
             return tuple(new_params), tuple(new_states), t, loss, aux

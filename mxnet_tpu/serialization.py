@@ -23,14 +23,34 @@ FORMAT_KEY = "__mxnet_tpu_format__"
 FORMAT_VERSION = 1
 
 
+DTYPE_SIDECAR = "__dtype__:"
+# Non-native-to-NumPy dtypes stored as a raw integer view + a sidecar entry
+# recording the real dtype; np.savez would otherwise silently write them as
+# void ('|V2') records that cannot be loaded back.
+_RAW_VIEWS = {"bfloat16": _np.uint16, "float8_e4m3fn": _np.uint8,
+              "float8_e5m2": _np.uint8}
+
+
 def save_ndarray_dict(filename, arrays: dict):
     """Save {name: NDArray|np.ndarray} (parity: mx.nd.save)."""
     out = {}
+    raw_by_size = {1: _np.uint8, 2: _np.uint16, 4: _np.uint32,
+                   8: _np.uint64}
     for k, v in arrays.items():
-        out[k] = _np.asarray(getattr(v, "asnumpy", lambda: v)())
+        a = _np.asarray(getattr(v, "asnumpy", lambda: v)())
+        name = a.dtype.name
+        if name in _RAW_VIEWS or a.dtype.kind == "V":
+            out[DTYPE_SIDECAR + k] = _np.asarray(name)
+            a = a.view(_RAW_VIEWS.get(name, raw_by_size[a.dtype.itemsize]))
+        out[k] = a
     out[FORMAT_KEY] = _np.asarray(FORMAT_VERSION)
     with open(filename, "wb") as f:
         _np.savez(f, **out)
+
+
+def _restore_dtype(arr, dtype_name):
+    import ml_dtypes
+    return arr.view(_np.dtype(getattr(ml_dtypes, dtype_name)))
 
 
 def load_ndarray_dict(filename) -> dict:
@@ -39,10 +59,17 @@ def load_ndarray_dict(filename) -> dict:
     from .ndarray.ndarray import NDArray
     try:
         with _np.load(filename, allow_pickle=False) as z:
-            if FORMAT_KEY in z.files:
-                return {k: NDArray(jnp.asarray(z[k])) for k in z.files
-                        if k != FORMAT_KEY}
-            return {k: NDArray(jnp.asarray(z[k])) for k in z.files}
+            sidecars = {k[len(DTYPE_SIDECAR):]: str(z[k])
+                        for k in z.files if k.startswith(DTYPE_SIDECAR)}
+            out = {}
+            for k in z.files:
+                if k == FORMAT_KEY or k.startswith(DTYPE_SIDECAR):
+                    continue
+                a = z[k]
+                if k in sidecars:
+                    a = _restore_dtype(a, sidecars[k])
+                out[k] = NDArray(jnp.asarray(a))
+            return out
     except (OSError, ValueError):
         pass  # not a zip — try the legacy binary format
     raw = load_mxnet_params(filename)
@@ -86,21 +113,25 @@ def load_parameter_dict(filename, params, allow_missing=False,
 # ---------------------------------------------------------------------------
 # Legacy MXNet .params binary reader (best-effort import path)
 # ---------------------------------------------------------------------------
-# Format (src/ndarray/ndarray.cc NDArray::Save + c_api MXNDArraySave):
+# Format (src/ndarray/ndarray.cc NDArray::Save/Load + c_api MXNDArraySave):
 #   uint64 kMXAPINDArrayListMagic = 0x112
 #   uint64 reserved
 #   uint64 ndarray-count N; N × NDArray records
 #   uint64 key-count K;     K × (uint64 len + bytes) names
-# Each NDArray record (dense, v2 layout):
-#   uint64 NDARRAY_MAGIC = 0xF993fac9da950d0b
-#   uint32 version; [int32 stype if version >= 2 — dense = -1/1? gated]
-#   shape: uint32 ndim + int64[ndim]   (TShape dmlc serialization)
-#   int32 dev_type, int32 dev_id, int32 type_flag
-#   raw data bytes (size = prod(shape) * dtype-size)
-# Older v1 files lack magic/version and start directly with the shape.
+# Each NDArray record starts with a uint32 magic:
+#   0xF993FAC8 (v1, int64 TShape):  shape (u32 ndim + i64[ndim]),
+#       i32 dev_type, i32 dev_id, i32 type_flag, raw data
+#   0xF993FAC9 / 0xF993FACA (v2 "+storage type" / v3 "np shape semantics"):
+#       i32 stype (dense = kDefaultStorage = 0; sparse rejected),
+#       shape (i32 ndim + i64[ndim]; v3 may store ndim = -1 for unknown),
+#       i32 dev_type, i32 dev_id, i32 type_flag, raw data
+#   any other value: v0 layout — the u32 just read IS ndim, followed by
+#       u32[ndim] dims, i32 dev_type, i32 dev_id, i32 type_flag, raw data
 
 _MX_LIST_MAGIC = 0x112
-_MX_ND_MAGIC = 0xF993FAC9DA950D0B
+_MX_ND_V1_MAGIC = 0xF993FAC8
+_MX_ND_V2_MAGIC = 0xF993FAC9
+_MX_ND_V3_MAGIC = 0xF993FACA
 _MX_DTYPES = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
               4: "int32", 5: "int8", 6: "int64", 7: "bool",
               12: "bfloat16"}
@@ -138,26 +169,31 @@ class _Reader:
 
 
 def _read_legacy_ndarray(r: _Reader):
-    start = r.o
-    magic = r.u64()
-    if magic == _MX_ND_MAGIC:
-        version = r.u32()
-        if version > 1:
-            stype = r.i32()
-            # NDArrayStorageType: kUndefinedStorage=-1, kDefaultStorage=0,
-            # kRowSparseStorage=1, kCSRStorage=2
-            if stype not in (-1, 0):
-                raise MXNetError(
-                    "legacy .params contains a sparse NDArray (stype="
-                    f"{stype}); sparse import is not supported on TPU "
-                    "(dense-only)")
+    magic = r.u32()
+    if magic in (_MX_ND_V2_MAGIC, _MX_ND_V3_MAGIC):
+        stype = r.i32()
+        # NDArrayStorageType: kUndefinedStorage=-1, kDefaultStorage=0,
+        # kRowSparseStorage=1, kCSRStorage=2
+        if stype not in (-1, 0):
+            raise MXNetError(
+                "legacy .params contains a sparse NDArray (stype="
+                f"{stype}); sparse import is not supported on TPU "
+                "(dense-only)")
+        ndim = r.i32()
+        if ndim < 0:  # v3 np semantics: unknown shape — cannot hold data
+            raise MXNetError("legacy .params NDArray has unknown shape")
+        shape = r.i64s(ndim)
+    elif magic == _MX_ND_V1_MAGIC:
         ndim = r.u32()
         shape = r.i64s(ndim)
     else:
-        # v0 layout: what we just read was the shape header
-        r.o = start
-        ndim = r.u32()
-        shape = r.i64s(ndim) if ndim else ()
+        # v0 layout: the u32 just read was ndim, dims are u32
+        ndim = magic
+        if ndim > 32:
+            raise MXNetError(
+                f"legacy .params record has implausible ndim {ndim} — "
+                "corrupt file or unsupported layout")
+        shape = tuple(r.u32() for _ in range(ndim))
     _dev_type, _dev_id = r.i32(), r.i32()
     type_flag = r.i32()
     dtype = _MX_DTYPES.get(type_flag)

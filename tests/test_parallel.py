@@ -166,3 +166,34 @@ def test_evalstep():
     ref = net(mx.nd.array(X))
     np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-5,
                                atol=1e-6)
+
+
+def test_trainstep_honors_wd_mult():
+    # wd_mult=0 on the bias (standard practice) must suppress weight decay
+    # in the fused step, matching the eager Trainer's _get_wd behavior.
+    X = np.random.default_rng(5).standard_normal((8, 16)).astype(np.float32)
+
+    net = nn.Dense(4, in_units=16)
+    net.initialize(mx.init.Xavier())
+    net.bias.wd_mult = 0.0
+    bias0 = net.bias.data().asnumpy().copy()
+    w0 = net.weight.data().asnumpy().copy()
+
+    class MeanLoss:
+        def __call__(self, out):
+            return out.mean()
+
+    o = opt.SGD(learning_rate=0.1, wd=0.5)
+    step = par.TrainStep(net, MeanLoss(), o, mesh=None, n_net_inputs=1)
+    step(mx.nd.array(X))
+    step.sync_params()
+
+    # d(mean(xW^T+b))/db = 1/4 per unit; no wd term on the bias
+    g_bias = np.full((4,), 1.0 / 4, np.float32)
+    np.testing.assert_allclose(net.bias.data().asnumpy(),
+                               bias0 - 0.1 * g_bias, rtol=1e-5, atol=1e-6)
+    # weight DOES get decayed: w1 = w0 - lr*(g + wd*w0)
+    g_w = np.tile(X.mean(axis=0) / 4, (4, 1)).astype(np.float32)
+    np.testing.assert_allclose(net.weight.data().asnumpy(),
+                               w0 - 0.1 * (g_w + 0.5 * w0), rtol=1e-4,
+                               atol=1e-5)

@@ -1,0 +1,101 @@
+"""Serialization: bf16 round-trip and the legacy MXNet .params layout.
+
+Parity targets: src/ndarray/ndarray.cc NDArray::Save/Load magics
+(NDARRAY_V1/V2/V3_MAGIC) and mx.nd.save/load semantics.
+"""
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serialization as ser
+from mxnet_tpu.base import MXNetError
+
+
+def test_save_load_bfloat16_roundtrip(tmp_path):
+    f = str(tmp_path / "w.npz")
+    a = mx.nd.array(np.arange(6).reshape(2, 3), dtype="bfloat16")
+    b = mx.nd.array(np.linspace(0, 1, 4), dtype="float32")
+    ser.save_ndarray_dict(f, {"a": a, "b": b})
+    out = ser.load_ndarray_dict(f)
+    assert set(out) == {"a", "b"}
+    assert out["a"].dtype == a.dtype
+    np.testing.assert_array_equal(out["a"].asnumpy().astype(np.float32),
+                                  a.asnumpy().astype(np.float32))
+    np.testing.assert_allclose(out["b"].asnumpy(), b.asnumpy())
+
+
+def test_save_load_float16_roundtrip(tmp_path):
+    f = str(tmp_path / "h.npz")
+    a = mx.nd.array(np.arange(4), dtype="float16")
+    ser.save_ndarray_dict(f, {"a": a})
+    out = ser.load_ndarray_dict(f)
+    assert out["a"].dtype == a.dtype
+
+
+def _legacy_record_v2(arr, magic=0xF993FAC9):
+    out = struct.pack("<I", magic)
+    out += struct.pack("<i", 0)  # kDefaultStorage
+    out += struct.pack("<i", arr.ndim)
+    out += struct.pack(f"<{arr.ndim}q", *arr.shape)
+    out += struct.pack("<iii", 1, 0, 0)  # cpu, dev 0, float32
+    out += arr.astype("<f4").tobytes()
+    return out
+
+
+def _legacy_record_v1(arr):
+    out = struct.pack("<I", 0xF993FAC8)
+    out += struct.pack("<I", arr.ndim)
+    out += struct.pack(f"<{arr.ndim}q", *arr.shape)
+    out += struct.pack("<iii", 1, 0, 0)
+    out += arr.astype("<f4").tobytes()
+    return out
+
+
+def _legacy_record_v0(arr):
+    out = struct.pack("<I", arr.ndim)
+    out += struct.pack(f"<{arr.ndim}I", *arr.shape)
+    out += struct.pack("<iii", 1, 0, 0)
+    out += arr.astype("<f4").tobytes()
+    return out
+
+
+def _legacy_file(tmp_path, records, names):
+    data = struct.pack("<QQ", 0x112, 0)
+    data += struct.pack("<Q", len(records)) + b"".join(records)
+    data += struct.pack("<Q", len(names))
+    for n in names:
+        nb = n.encode()
+        data += struct.pack("<Q", len(nb)) + nb
+    f = tmp_path / "legacy.params"
+    f.write_bytes(data)
+    return str(f)
+
+
+@pytest.mark.parametrize("rec", [_legacy_record_v0, _legacy_record_v1,
+                                 _legacy_record_v2])
+def test_legacy_params_layouts(tmp_path, rec):
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    b = np.array([1.0, -2.0], dtype=np.float32)
+    f = _legacy_file(tmp_path, [rec(w), rec(b)], ["arg:w", "aux:b"])
+    out = ser.load_mxnet_params(f)
+    np.testing.assert_array_equal(out["arg:w"], w)
+    np.testing.assert_array_equal(out["aux:b"], b)
+    # and via the transparent loader, with prefix stripping downstream
+    nd = ser.load_ndarray_dict(f)
+    np.testing.assert_array_equal(nd["arg:w"].asnumpy(), w)
+
+
+def test_legacy_params_v3_magic(tmp_path):
+    w = np.ones((2, 2), dtype=np.float32)
+    f = _legacy_file(tmp_path, [_legacy_record_v2(w, magic=0xF993FACA)],
+                     ["w"])
+    np.testing.assert_array_equal(ser.load_mxnet_params(f)["w"], w)
+
+
+def test_legacy_params_sparse_rejected(tmp_path):
+    rec = struct.pack("<I", 0xF993FAC9) + struct.pack("<i", 1)  # row_sparse
+    f = _legacy_file(tmp_path, [rec], ["w"])
+    with pytest.raises(MXNetError, match="sparse"):
+        ser.load_mxnet_params(f)
