@@ -46,8 +46,6 @@ def __getattr__(name):
         "recordio": "mxnet_tpu.io.recordio",
         "image": "mxnet_tpu.image",
         "test_utils": "mxnet_tpu.test_utils",
-        "symbol": "mxnet_tpu.symbol",
-        "sym": "mxnet_tpu.symbol",
         "runtime": "mxnet_tpu.runtime",
         "engine": "mxnet_tpu.engine",
         "context": "mxnet_tpu.device",
@@ -58,4 +56,10 @@ def __getattr__(name):
         mod = importlib.import_module(lazy[name])
         globals()[name] = mod
         return mod
+    if name in ("symbol", "sym"):
+        raise AttributeError(
+            "the legacy Symbol API (mx.sym) is de-scoped: HybridBlock "
+            "tracing into XLA replaces the nnvm graph path (SURVEY.md "
+            "§7.1); export/import graphs via HybridBlock.export "
+            "(StableHLO) instead")
     raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

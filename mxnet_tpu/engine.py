@@ -1,0 +1,70 @@
+"""mx.engine — execution-mode knobs (debug sync mode, bulking parity).
+
+Reference parity: src/engine/ (SURVEY.md §2.1) exposed via
+MXNET_ENGINE_TYPE and python/mxnet/engine.py's bulk() scope. On TPU the
+dependency engine itself is PjRt async dispatch + XLA program order, so
+the *machinery* is not rebuilt (SURVEY.md §7.1) — but its two user-visible
+debug affordances are:
+
+  * NaiveEngine (SURVEY.md §5.2 — the canonical "is it a race / async
+    error?" triage recipe): `set_engine_type("NaiveEngine")` or env
+    MXNET_ENGINE_TYPE=NaiveEngine makes every eager op dispatch
+    synchronous (block_until_ready after each op), so exceptions surface
+    at the faulting op instead of at the next sync point.
+  * bulk(size): in the reference this batches engine pushes
+    (MXNET_EXEC_BULK_EXEC_*); under XLA whole traced graphs already
+    compile into one program, so this is an accepted no-op scope kept for
+    source compatibility.
+"""
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+
+from .base import MXNetError
+
+__all__ = ["set_engine_type", "engine_type", "is_sync", "bulk",
+           "set_bulk_size"]
+
+_ENGINE_TYPES = ("ThreadedEnginePerDevice", "ThreadedEnginePooled",
+                 "NaiveEngine")
+
+_state = {
+    "type": os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice"),
+    "bulk_size": int(os.environ.get("MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN",
+                                    "15") or 0),
+}
+if _state["type"] not in _ENGINE_TYPES:
+    _state["type"] = "ThreadedEnginePerDevice"
+
+
+def set_engine_type(name: str):
+    if name not in _ENGINE_TYPES:
+        raise MXNetError(f"unknown engine type {name!r}; one of "
+                         f"{_ENGINE_TYPES}")
+    _state["type"] = name
+
+
+def engine_type() -> str:
+    return _state["type"]
+
+
+def is_sync() -> bool:
+    """True when eager dispatch should synchronize per-op (NaiveEngine)."""
+    return _state["type"] == "NaiveEngine"
+
+
+def set_bulk_size(size: int) -> int:
+    prev, _state["bulk_size"] = _state["bulk_size"], int(size)
+    return prev
+
+
+@contextmanager
+def bulk(size: int):
+    """Parity: mx.engine.bulk(size) scope. No-op under XLA (fusion happens
+    at compile time); retained so reference code runs unchanged."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

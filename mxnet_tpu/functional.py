@@ -1,0 +1,107 @@
+"""mx.functional — composable functional transforms over NDArray functions.
+
+This is the TPU-native answer to the reference's higher-order autograd
+(`mx.autograd.grad(create_graph=True)`, tests/python/unittest/
+test_higher_order_grad.py): instead of replaying an imperative tape through
+itself, expose jax's function transforms directly over MXNet-style
+functions. A "functional" here is any Python callable taking/returning
+NDArrays (or pytrees of them); the wrappers below unwrap to jax.Arrays,
+apply the jax transform, and rewrap — so grad(grad(f)) composes to any
+depth, and jit/vmap compose with both.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["grad", "value_and_grad", "jacobian", "jacfwd", "jacrev",
+           "hessian", "jit", "vmap", "eval_shape"]
+
+
+def _nd_cls():
+    from .ndarray.ndarray import NDArray
+    return NDArray
+
+
+def _unwrap(tree):
+    NDArray = _nd_cls()
+    return jax.tree.map(lambda x: x._data if isinstance(x, NDArray) else x,
+                        tree, is_leaf=lambda x: isinstance(x, NDArray))
+
+
+def _wrap(tree):
+    NDArray = _nd_cls()
+    return jax.tree.map(
+        lambda x: NDArray(x) if isinstance(x, jax.Array) else x, tree)
+
+
+def _functionalize(fn):
+    """NDArray-function → jax-array function (for use inside transforms)."""
+
+    @functools.wraps(fn)
+    def jfn(*args, **kwargs):
+        out = fn(*_wrap(args), **_wrap(kwargs))
+        return _unwrap(out)
+
+    return jfn
+
+
+def _transform(jax_transform):
+    def make(fn, *targs, **tkwargs):
+        if not callable(fn):
+            raise MXNetError("first argument must be a callable")
+        tfn = jax_transform(_functionalize(fn), *targs, **tkwargs)
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return _wrap(tfn(*_unwrap(args), **_unwrap(kwargs)))
+
+        return wrapped
+
+    return make
+
+
+def grad(fn, argnums=0, has_aux=False):
+    """d fn / d args[argnums]; composes to any order: grad(grad(fn)).
+
+    fn must return a scalar NDArray (plus aux if has_aux)."""
+    return _transform(jax.grad)(fn, argnums=argnums, has_aux=has_aux)
+
+
+def value_and_grad(fn, argnums=0, has_aux=False):
+    return _transform(jax.value_and_grad)(fn, argnums=argnums,
+                                          has_aux=has_aux)
+
+
+def jacfwd(fn, argnums=0):
+    return _transform(jax.jacfwd)(fn, argnums=argnums)
+
+
+def jacrev(fn, argnums=0):
+    return _transform(jax.jacrev)(fn, argnums=argnums)
+
+
+jacobian = jacrev
+
+
+def hessian(fn, argnums=0):
+    return _transform(jax.hessian)(fn, argnums=argnums)
+
+
+def vmap(fn, in_axes=0, out_axes=0):
+    return _transform(jax.vmap)(fn, in_axes=in_axes, out_axes=out_axes)
+
+
+def jit(fn, static_argnums=()):
+    """Compile an NDArray function into one XLA program (the functional
+    counterpart of HybridBlock.hybridize)."""
+    return _transform(jax.jit)(fn, static_argnums=static_argnums)
+
+
+def eval_shape(fn, *args, **kwargs):
+    """Trace fn without running it; returns jax.ShapeDtypeStruct pytree."""
+    return jax.eval_shape(_functionalize(fn), *_unwrap(args),
+                          **_unwrap(kwargs))

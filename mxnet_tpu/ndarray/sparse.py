@@ -1,0 +1,123 @@
+"""Sparse NDArray shim — dense-backed, documented de-scope.
+
+Reference parity: python/mxnet/ndarray/sparse.py (RowSparseNDArray /
+CSRNDArray over kRowSparseStorage / kCSRStorage chunks, SURVEY.md §2.1).
+XLA has no sparse buffer type, so on TPU sparse *storage* is intentionally
+de-scoped (SURVEY.md §7.3.5: "dense-backed shim + documented de-scope of
+PS sparse pull"). What this module provides:
+
+  * `csr_matrix` / `row_sparse_array` constructors accepting the reference's
+    (data, indices[, indptr]) forms and returning DENSE-backed subclasses
+    that remember their nominal stype, so code probing `.stype`,
+    `.tostype()`, `.indices` etc. keeps working;
+  * `.tostype("default")` and arithmetic fall through to the dense NDArray
+    implementation (XLA fuses the zeros away for genuinely sparse data);
+  * anything that only makes sense for true sparse storage (retain,
+    save as sparse, dist row_sparse_pull) raises MXNetError with this
+    de-scope note.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .ndarray import NDArray, array
+
+__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix",
+           "row_sparse_array", "BaseSparseNDArray"]
+
+_DESCOPE = ("sparse storage is de-scoped on TPU (XLA has no sparse "
+            "buffers); this shim is dense-backed — convert with "
+            "tostype('default') for anything beyond basic access")
+
+
+class BaseSparseNDArray(NDArray):
+    _stype = "default"
+
+    @property
+    def stype(self):
+        return self._stype
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data)
+        if stype == self._stype:
+            return self
+        raise MXNetError(f"cannot convert {self._stype} to {stype}; "
+                         + _DESCOPE)
+
+    def retain(self, *a, **k):
+        raise MXNetError("retain: " + _DESCOPE)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """CSR-format view over a dense buffer (parity: mx.nd.sparse.CSRNDArray).
+    `.data/.indices/.indptr` are recomputed from the dense values."""
+    _stype = "csr"
+
+    @property
+    def indptr(self):
+        a = _np.asarray(self._data)
+        counts = (a != 0).sum(axis=1)
+        return array(_np.concatenate([[0], _np.cumsum(counts)]),
+                     dtype="int64")
+
+    @property
+    def indices(self):
+        a = _np.asarray(self._data)
+        return array(_np.nonzero(a)[1].astype(_np.int64), dtype="int64")
+
+    @property
+    def data(self):
+        a = _np.asarray(self._data)
+        return array(a[a != 0])
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse view over a dense buffer (parity: RowSparseNDArray)."""
+    _stype = "row_sparse"
+
+    @property
+    def indices(self):
+        a = _np.asarray(self._data)
+        nz = _np.where(a.reshape(a.shape[0], -1).any(axis=1))[0]
+        return array(nz.astype(_np.int64), dtype="int64")
+
+    @property
+    def data(self):
+        a = _np.asarray(self._data)
+        nz = _np.where(a.reshape(a.shape[0], -1).any(axis=1))[0]
+        return array(a[nz])
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSR array. Accepts a dense array-like, or the tuple form
+    (data, indices, indptr) as in the reference."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = (_np.asarray(x) for x in arg1)
+        if shape is None:
+            raise MXNetError("csr_matrix((data, indices, indptr)) needs "
+                             "an explicit shape=")
+        dense = _np.zeros(shape, dtype=dtype or data.dtype)
+        for row in range(shape[0]):
+            lo, hi = int(indptr[row]), int(indptr[row + 1])
+            dense[row, indices[lo:hi]] = data[lo:hi]
+        arg1 = dense
+    nd = array(arg1, dtype=dtype, ctx=ctx)
+    return CSRNDArray(nd._data)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a row-sparse array. Accepts dense array-like, or
+    (data, indices) as in the reference."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = _np.asarray(arg1[0]), _np.asarray(arg1[1])
+        if shape is None:
+            raise MXNetError("row_sparse_array((data, indices)) needs an "
+                             "explicit shape=")
+        dense = _np.zeros(shape, dtype=dtype or data.dtype)
+        dense[indices] = data
+        arg1 = dense
+    nd = array(arg1, dtype=dtype, ctx=ctx)
+    return RowSparseNDArray(nd._data)

@@ -1,0 +1,81 @@
+"""mx.np — the NumPy-semantics array namespace.
+
+Reference parity: python/mxnet/numpy/ (SURVEY.md §2.3 "NumPy ops") — the
+v2-era primary array API (`mx.np.*` mirrors numpy, `mx.npx` holds the
+ML extensions). Here the core NDArray already follows NumPy semantics
+(true broadcasting, numpy dtype promotion), so this namespace is:
+
+  1. re-exports of the nd op surface under their numpy names;
+  2. a dynamic fallback that lifts any remaining `jax.numpy` function
+     into an NDArray op on first access (unwrap → jnp kernel → rewrap,
+     with autograd taping via the op registry funnel) — mirroring how the
+     reference code-generates np_* stubs from the C++ registry.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+import jax.numpy as _jnp
+
+from ..ndarray.ndarray import NDArray, array, newaxis  # noqa: F401
+from ..ndarray import (  # noqa: F401
+    zeros, ones, full, empty, arange, linspace, eye, tri, meshgrid,
+    concatenate, stack, transpose, reshape, squeeze, expand_dims, tile,
+    repeat, flip, roll, tril, triu, take, zeros_like, ones_like, full_like,
+    diag, pad, split_v2 as split, swapaxes, broadcast_to,
+)
+from ..ops import math as _m
+from ..ops import random  # noqa: F401  (mx.np.random)
+from ..ops.registry import op as _op
+
+ndarray = NDArray
+
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+euler_gamma = _onp.euler_gamma
+
+float16 = "float16"
+float32 = "float32"
+float64 = "float64"
+bfloat16 = "bfloat16"
+int8 = "int8"
+int16 = "int16"
+int32 = "int32"
+int64 = "int64"
+uint8 = "uint8"
+bool_ = "bool"
+
+_cache = {}
+
+
+def _lift(name):
+    """Lift jax.numpy.<name> into a taped NDArray op (cached)."""
+    jfn = getattr(_jnp, name)
+    wrapped = _op(name=f"np_{name}", register=False)(jfn)
+    wrapped.__name__ = name
+    return wrapped
+
+
+def __getattr__(name):
+    if name in _cache:
+        return _cache[name]
+    from .. import ndarray as _nd
+    target = None
+    if hasattr(_m, name):
+        target = getattr(_m, name)
+    elif hasattr(_nd, name):
+        target = getattr(_nd, name)
+    elif hasattr(_jnp, name):
+        cand = getattr(_jnp, name)
+        # lift plain functions only — classes (jnp.dtype, jnp.ndarray, …)
+        # are not array ops and must pass through untouched
+        if isinstance(cand, type) or not callable(cand):
+            target = cand
+        else:
+            target = _lift(name)
+    if target is None:
+        raise AttributeError(f"module 'mxnet_tpu.numpy' has no attribute "
+                             f"{name!r}")
+    _cache[name] = target
+    return target

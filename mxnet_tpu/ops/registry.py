@@ -18,6 +18,7 @@ import functools
 
 import jax
 
+from .. import engine as _engine
 from ..autograd import is_recording, is_tracked, record_node
 from ..base import MXNetError, Registry
 
@@ -48,6 +49,11 @@ def apply_op(name, closed_fn, array_args, out=None, nodiff=False):
         out_data = closed_fn(*datas)
     multi = isinstance(out_data, (tuple, list))
     out_list = list(out_data) if multi else [out_data]
+    if _engine.is_sync():
+        # NaiveEngine debug mode: surface async errors at the faulting op
+        for d in out_list:
+            if hasattr(d, "block_until_ready"):
+                d.block_until_ready()
     outs = [NDArray(d) for d in out_list]
     if rec:
         record_node(name, vjp_fn, array_args, outs, multi=multi)

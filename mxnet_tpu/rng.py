@@ -22,8 +22,11 @@ import jax.random as jrandom
 
 
 class _RngState(threading.local):
+    # key is created LAZILY: materializing a PRNGKey initializes the XLA
+    # backend, which must not happen at import time (it would break
+    # jax.distributed.initialize for multi-process users — kvstore.py)
     def __init__(self):
-        self.key = jrandom.PRNGKey(0)
+        self.key = None
         self.scopes = []  # list of [base_key, counter]
 
 
@@ -41,6 +44,8 @@ def next_key():
         scope = _state.scopes[-1]
         scope[1] += 1
         return jrandom.fold_in(scope[0], scope[1])
+    if _state.key is None:
+        _state.key = jrandom.PRNGKey(0)
     _state.key, sub = jrandom.split(_state.key)
     return sub
 

@@ -1,0 +1,80 @@
+"""mx.runtime — feature introspection.
+
+Reference parity: python/mxnet/runtime.py — Features / feature_list()
+backed by src/libinfo.cc compile-time flags (SURVEY.md §2.1 "Init &
+lifecycle", §5.6 layer 3). Here the "build flags" are runtime properties
+of the JAX/XLA stack, probed once on first access.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+def _probe():
+    import jax
+
+    feats = {}
+
+    def have_platform(p):
+        try:
+            return len(jax.devices(p)) > 0
+        except RuntimeError:
+            return False
+
+    feats["CPU"] = True
+    feats["TPU"] = have_platform("tpu")
+    feats["CUDA"] = have_platform("gpu")  # parity name for the flag
+    feats["BF16"] = True                  # first-class on every XLA backend
+    feats["F16C"] = True
+    feats["INT64_TENSOR_SIZE"] = True     # jax uses 64-bit sizes natively
+    feats["SIGNAL_HANDLER"] = True        # python default faulthandler path
+    try:
+        import jax.experimental.pallas  # noqa: F401
+        feats["PALLAS"] = True
+    except ImportError:
+        feats["PALLAS"] = False
+    feats["DIST_KVSTORE"] = True          # kvstore.py + jax.distributed
+    feats["X64"] = bool(jax.config.read("jax_enable_x64"))
+    # de-scoped reference features, reported disabled for honest probing
+    for off in ("CUDNN", "NCCL", "TENSORRT", "ONEDNN", "MKLDNN", "OPENCV",
+                "BLAS_MKL", "TVM_OP", "CAFFE", "PROFILER_NVTX"):
+        feats[off] = False
+    return feats
+
+
+class Features(dict):
+    """Parity: mx.runtime.Features — dict of Feature with is_enabled()."""
+
+    instance = None
+
+    def __new__(cls):
+        if cls.instance is None:
+            cls.instance = super().__new__(cls)
+            cls.instance.update(
+                {k: Feature(k, v) for k, v in _probe().items()})
+        return cls.instance
+
+    def __repr__(self):
+        return str(list(self.values()))
+
+    def is_enabled(self, feature_name):
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise MXNetError(f"unknown feature '{feature_name}'; known: "
+                             f"{sorted(self)}")
+        return self[feature_name].enabled
+
+
+def feature_list():
+    return list(Features().values())

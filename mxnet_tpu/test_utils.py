@@ -1,0 +1,193 @@
+"""mx.test_utils — the numeric-correctness toolkit.
+
+Reference parity: python/mxnet/test_utils.py — every operator there is
+tested three ways (SURVEY.md §4): finite-difference vs autograd
+(`check_numeric_gradient`), against a NumPy reference implementation
+(`check_symbolic_forward`-style asserts), and across backends/dtypes
+(`check_consistency`, THE cpu-vs-gpu oracle — here the oracle pair is
+XLA:CPU vs whatever accelerator is attached, plus dtype sweeps).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["assert_almost_equal", "same", "almost_equal", "rand_ndarray",
+           "rand_shape_nd", "default_tolerances", "check_numeric_gradient",
+           "check_consistency", "default_context", "list_contexts"]
+
+# dtype-aware default tolerances (parity: assert_almost_equal's internal
+# rtol/atol table; bf16 added for TPU-first testing)
+_DEFAULT_RTOL = {_np.dtype(_np.float16): 1e-2, _np.dtype(_np.float32): 1e-4,
+                 _np.dtype(_np.float64): 1e-6}
+_DEFAULT_ATOL = {_np.dtype(_np.float16): 1e-3, _np.dtype(_np.float32): 1e-5,
+                 _np.dtype(_np.float64): 1e-8}
+_BF16_RTOL, _BF16_ATOL = 2e-2, 2e-3
+
+
+def _to_numpy(x):
+    a = getattr(x, "asnumpy", None)
+    if a is not None:
+        x = a()
+    x = _np.asarray(x)
+    if x.dtype.kind == "V" or x.dtype.name == "bfloat16":
+        x = x.astype(_np.float32)
+    return x
+
+
+def _dtype_of(a):
+    dt = getattr(a, "dtype", None)
+    return dt if dt is not None else _np.asarray(a).dtype
+
+
+def default_tolerances(*arrays):
+    rtol = atol = 0.0
+    for a in arrays:
+        dt = _dtype_of(a)  # dtype only — no device-to-host transfer
+        if getattr(dt, "name", str(dt)) == "bfloat16":
+            rtol, atol = max(rtol, _BF16_RTOL), max(atol, _BF16_ATOL)
+            continue
+        try:
+            d = _np.dtype(dt)
+        except TypeError:
+            continue
+        rtol = max(rtol, _DEFAULT_RTOL.get(d, 0.0))
+        atol = max(atol, _DEFAULT_ATOL.get(d, 0.0))
+    return (rtol or 1e-5), (atol or 1e-8)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    """Parity: test_utils.assert_almost_equal — dtype-aware tolerances."""
+    drtol, datol = default_tolerances(a, b)
+    rtol = drtol if rtol is None else rtol
+    atol = datol if atol is None else atol
+    an, bn = _to_numpy(a), _to_numpy(b)
+    _np.testing.assert_allclose(
+        an, bn, rtol=rtol, atol=atol, equal_nan=equal_nan,
+        err_msg=f"{names[0]} !~ {names[1]} (rtol={rtol}, atol={atol})")
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    try:
+        assert_almost_equal(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+        return True
+    except AssertionError:
+        return False
+
+
+def same(a, b):
+    return _np.array_equal(_to_numpy(a), _to_numpy(b))
+
+
+def rand_shape_nd(ndim, dim=10, allow_zero_size=False):
+    low = 0 if allow_zero_size else 1
+    return tuple(_np.random.randint(low, dim + 1, size=ndim))
+
+
+def rand_ndarray(shape, dtype="float32", scale=1.0, ctx=None):
+    from .ndarray import array
+    data = _np.random.standard_normal(shape) * scale
+    return array(data, dtype=dtype, ctx=ctx)
+
+
+def default_context():
+    from .device import current_context
+    return current_context()
+
+
+def list_contexts():
+    """All distinct device platforms available (cpu always; tpu/gpu when
+    attached) — the check_consistency sweep axis."""
+    import jax
+    from .device import Device
+    out = []
+    for plat in ("cpu", "tpu", "gpu"):
+        try:
+            devs = jax.devices(plat)
+        except RuntimeError:
+            continue
+        if devs:
+            out.append(Device(plat, 0))
+    return out
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-4, rtol=1e-2, atol=1e-4,
+                           argnums=None):
+    """Finite-difference vs autograd oracle (parity:
+    test_utils.check_numeric_gradient).
+
+    fn: callable over NDArrays returning one NDArray (any shape; reduced
+    by sum for the scalar loss). inputs: list of NDArrays (float64
+    recommended for a tight eps). argnums: which inputs to check (default
+    all)."""
+    from . import autograd
+    from .ndarray import array
+
+    argnums = range(len(inputs)) if argnums is None else argnums
+    inputs = list(inputs)
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*inputs)
+        loss = out.sum()
+    loss.backward()
+    analytic = [inputs[i].grad.asnumpy().astype(_np.float64)
+                for i in argnums]
+
+    def scalar_loss(arrays):
+        return float(fn(*arrays).sum().asscalar())
+
+    for gi, i in enumerate(argnums):
+        base = inputs[i].asnumpy().astype(_np.float64)
+        numeric = _np.zeros_like(base)
+        flat = base.reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for j in range(flat.size):
+            for sign in (+1, -1):
+                pert = flat.copy()
+                pert[j] += sign * eps
+                arrs = list(inputs)
+                arrs[i] = array(pert.reshape(base.shape),
+                                dtype=str(base.dtype))
+                num_flat[j] += sign * scalar_loss(arrs)
+            num_flat[j] /= 2 * eps
+        _np.testing.assert_allclose(
+            analytic[gi], numeric, rtol=rtol, atol=atol,
+            err_msg=f"analytic vs numeric gradient mismatch for input {i}")
+
+
+def check_consistency(fn, inputs, ctx_list=None, dtypes=("float32",),
+                      rtol=None, atol=None):
+    """Run fn on every (context, dtype) pair and assert all outputs agree
+    with the first (parity: test_utils.check_consistency; the reference's
+    cpu-vs-gpu oracle, here cpu-XLA vs accelerator and dtype sweep)."""
+    from .ndarray import array
+
+    ctx_list = ctx_list or list_contexts()
+    if not ctx_list:
+        raise MXNetError("no contexts available for check_consistency")
+    ref = None
+    for ctx in ctx_list:
+        for dt in dtypes:
+            with ctx:
+                arrs = [array(_to_numpy(x), dtype=dt) for x in inputs]
+                out = fn(*arrs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            vals = [_to_numpy(o).astype(_np.float64) for o in outs]
+            if ref is None:
+                ref = vals
+                continue
+            for r, v in zip(ref, vals):
+                a_rtol, a_atol = (rtol, atol)
+                if a_rtol is None or a_atol is None:
+                    drt, dat = default_tolerances(
+                        _np.zeros((), dtype=dt if dt != "bfloat16"
+                                  else "float16"))
+                    a_rtol = drt if a_rtol is None else a_rtol
+                    a_atol = dat if a_atol is None else a_atol
+                _np.testing.assert_allclose(
+                    r, v, rtol=a_rtol, atol=a_atol,
+                    err_msg=f"inconsistent result on {ctx} dtype={dt}")
+    return ref
