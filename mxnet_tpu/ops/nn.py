@@ -639,12 +639,27 @@ def RNN(data, parameters, state, state_cell=None, state_size=None,
 @op("dot_product_attention")
 def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
                           dropout_p=0.0, impl="auto"):
-    """q,k,v: (B, H, T, D). impl: 'auto'|'xla'|'flash' — 'flash' routes to
-    the blockwise/Pallas kernel in ops/attention.py (same semantics, O(T)
-    memory); 'auto' switches to flash for long sequences (Tq >= 1024, no
-    dropout) where the O(T^2) logits matrix stops fitting comfortably; for
-    short sequences one fused XLA softmax-attention is fastest.
-    Fully-masked rows yield zeros (not NaN) on every path."""
+    """q,k,v: (B, H, T, D). impl: 'auto'|'xla'|'fused'|'flash'.
+
+    'fused' is the Pallas TPU kernel (ops/pallas_attention.py): whole-row
+    softmax→dropout→PV in VMEM with the dropout mask drawn from the
+    on-core hardware PRNG — the hot path for T <= 1024 (BERT/GPT-2
+    shapes), with or without dropout. 'flash' is the blockwise O(T)
+    kernel in ops/attention.py for long sequences; 'auto' picks fused on
+    TPU when shapes allow, flash for long no-dropout sequences, else one
+    XLA softmax-attention. Fully-masked rows yield zeros on every path."""
+    if mask is not None and mask.ndim == 2:
+        # (B, Tk) key-padding → canonical (B, 1, 1, Tk) for every path
+        mask = mask[:, None, None, :]
+    train_drop = dropout_p > 0 and is_training()
+    if impl in ("auto", "fused"):
+        from . import pallas_attention as _pa
+        if (jax.devices()[0].platform == "tpu"
+                and _pa.supported(q, k, mask)):
+            key = _rng.next_key() if train_drop else None
+            return _pa.fused_attention(
+                q, k, v, mask=mask, scale=scale, causal=causal,
+                dropout_p=dropout_p if train_drop else 0.0, key=key)
     if impl == "flash" or (impl == "auto" and dropout_p == 0.0
                            and q.shape[-2] >= 1024):
         from . import attention as _att
