@@ -92,10 +92,16 @@ class Trainer:
         if self._kvstore is not None:
             for i, p in enumerate(self._params):
                 if p.grad_req != "null":
+                    if i not in self._kv_inited_keys:
+                        # parity: reference Trainer._init_params init()s
+                        # each param into the store before first pushpull
+                        self._kvstore.init(i, p.data())
+                        self._kv_inited_keys.add(i)
                     self._kvstore.pushpull(i, p.grad(), out=p.grad())
 
     def _init_kvstore(self):
         self._kvstore = None
+        self._kv_inited_keys = set()
         if self._kvstore_type not in (None, "device", "local"):
             from .. import kvstore as kv
             store = kv.create(self._kvstore_type)

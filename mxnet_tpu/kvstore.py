@@ -208,9 +208,11 @@ class KVStore:
         if self._updater is None:
             vp = dict(self._pairs(key, value))
             for k, o in self._pairs(key, out):
+                if k not in self._store:
+                    raise MXNetError(
+                        f"key {k!r} not initialized; call init()")
                 merged = self._merge(vp[k])
-                if k in self._store:
-                    self._store[k] = merged
+                self._store[k] = merged
                 for oo in _as_list(o):
                     oo._rebind(merged)
             return out

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import builtins
 import math as _pymath
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -636,6 +637,27 @@ def RNN(data, parameters, state, state_cell=None, state_size=None,
 # long sequences, see mxnet_tpu/ops/attention.py)
 # ---------------------------------------------------------------------------
 
+def _target_platform(x):
+    """Platform the op will execute on: an active Device scope wins (so the
+    check_consistency cpu-vs-accelerator oracle stays honest), else the
+    committed placement of the input, else jax's default backend."""
+    from ..base import current_scope
+    dev = current_scope("device")
+    if dev is not None:
+        try:
+            return dev.jax_device.platform
+        except Exception:
+            pass  # scope names an unavailable backend — fall through
+    devices = getattr(x, "devices", None)
+    if devices is not None:
+        try:
+            ds = devices()
+            if ds:
+                return next(iter(ds)).platform
+        except Exception:
+            pass
+    return jax.default_backend()
+
 @op("dot_product_attention")
 def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
                           dropout_p=0.0, impl="auto"):
@@ -654,12 +676,21 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
     train_drop = dropout_p > 0 and is_training()
     if impl in ("auto", "fused"):
         from . import pallas_attention as _pa
-        if (jax.devices()[0].platform == "tpu"
-                and _pa.supported(q, k, mask)):
+        on_tpu = _target_platform(q) == "tpu"
+        ok = on_tpu and _pa.supported(q, k, mask)
+        if ok:
             key = _rng.next_key() if train_drop else None
             return _pa.fused_attention(
                 q, k, v, mask=mask, scale=scale, causal=causal,
                 dropout_p=dropout_p if train_drop else 0.0, key=key)
+        if impl == "fused":
+            # An explicit request must not silently measure a different
+            # kernel; only impl='auto' may fall back quietly.
+            warnings.warn(
+                "impl='fused' requested but the Pallas kernel is unavailable "
+                f"(platform={_target_platform(q)!r}, "
+                f"shape_supported={_pa.supported(q, k, mask)}); falling back "
+                "to the XLA path", stacklevel=2)
     if impl == "flash" or (impl == "auto" and dropout_p == 0.0
                            and q.shape[-2] >= 1024):
         from . import attention as _att

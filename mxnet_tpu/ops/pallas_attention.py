@@ -67,22 +67,47 @@ def _softmax_parts(s):
     return e, l
 
 
-def _keep_mask(seed_ref, p_drop, shape):
+def _software_bits(s0, s1, shape):
+    """Counter-based software PRNG (murmur3 finalizer mixing) used when the
+    hardware PRNG is unavailable (interpret mode on CPU). Deterministic in
+    (s0, s1, position) so the backward pass regenerates the same mask."""
+    pos = (lax.broadcasted_iota(jnp.uint32, shape, 0)
+           * jnp.uint32(shape[1])
+           + lax.broadcasted_iota(jnp.uint32, shape, 1))
+
+    def mix(x):
+        x = x ^ (x >> 16)
+        x = x * jnp.uint32(0x85EBCA6B)
+        x = x ^ (x >> 13)
+        x = x * jnp.uint32(0xC2B2AE35)
+        return x ^ (x >> 16)
+
+    return mix(mix(pos ^ s0) ^ s1)
+
+
+def _keep_mask(seed_ref, p_drop, shape, interpret=False):
     # one seed per (batch, head) grid cell; the hardware PRNG accepts at
-    # most two seed words, so fold the cell index into one
+    # most two seed words, so both 32-bit key words are used and the cell
+    # index is folded into the second (distinct cells and distinct keys
+    # both perturb the seed)
     cell = pl.program_id(0) * pl.num_programs(1) + pl.program_id(1)
-    pltpu.prng_seed(seed_ref[0], cell)
-    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    if interpret:
+        bits = _software_bits(seed_ref[0].astype(jnp.uint32),
+                              (seed_ref[1] ^ cell).astype(jnp.uint32),
+                              shape)
+    else:
+        pltpu.prng_seed(seed_ref[0], seed_ref[1] ^ cell)
+        bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
     return bits >= jnp.uint32(min(int(p_drop * 2.0 ** 32), 2 ** 32 - 1))
 
 
 def _fwd_kernel(seed_ref, bias_ref, q_ref, k_ref, v_ref, o_ref, *,
-                scale, p_drop, causal, tq, tk):
+                scale, p_drop, causal, tq, tk, interpret=False):
     s = _scores(q_ref, k_ref, bias_ref, scale, causal, tq, tk)
     e, l = _softmax_parts(s)
     inv_keep = 1.0
     if p_drop > 0.0:
-        keep = _keep_mask(seed_ref, p_drop, (tq, tk))
+        keep = _keep_mask(seed_ref, p_drop, (tq, tk), interpret)
         e = jnp.where(keep, e, 0.0)
         inv_keep = 1.0 / (1.0 - p_drop)
     v = v_ref[0, 0]
@@ -93,14 +118,16 @@ def _fwd_kernel(seed_ref, bias_ref, q_ref, k_ref, v_ref, o_ref, *,
 
 
 def _bwd_kernel(seed_ref, bias_ref, q_ref, k_ref, v_ref, do_ref,
-                dq_ref, dk_ref, dv_ref, *, scale, p_drop, causal, tq, tk):
+                dq_ref, dk_ref, dv_ref, *, scale, p_drop, causal, tq, tk,
+                interpret=False):
     s = _scores(q_ref, k_ref, bias_ref, scale, causal, tq, tk)
     e, l = _softmax_parts(s)
     p = e / jnp.maximum(l, 1e-30)           # pre-dropout softmax
     inv_keep = 1.0
     a = p
     if p_drop > 0.0:
-        keep = _keep_mask(seed_ref, p_drop, (tq, tk))  # same seed → same mask
+        # same seed → same mask (the recompute trick; _keep_mask is pure)
+        keep = _keep_mask(seed_ref, p_drop, (tq, tk), interpret)
         inv_keep = 1.0 / (1.0 - p_drop)
         a = jnp.where(keep, p, 0.0) * inv_keep
     v = v_ref[0, 0]
@@ -148,7 +175,8 @@ def _fused_fwd(q, k, v, bias, seed, scale, p_drop, causal, interpret):
     Tk = k.shape[2]
     qspec, kspec, bspec = _specs(B, H, Tq, Tk, D)
     kernel = functools.partial(_fwd_kernel, scale=scale, p_drop=p_drop,
-                               causal=causal, tq=Tq, tk=Tk)
+                               causal=causal, tq=Tq, tk=Tk,
+                               interpret=interpret)
     out = pl.pallas_call(
         kernel,
         grid=(B, H),
@@ -167,7 +195,8 @@ def _fused_bwd(scale, p_drop, causal, interpret, res, g):
     Tk = k.shape[2]
     qspec, kspec, bspec = _specs(B, H, Tq, Tk, D)
     kernel = functools.partial(_bwd_kernel, scale=scale, p_drop=p_drop,
-                               causal=causal, tq=Tq, tk=Tk)
+                               causal=causal, tq=Tq, tk=Tk,
+                               interpret=interpret)
     dq, dk, dv = pl.pallas_call(
         kernel,
         grid=(B, H),
@@ -231,8 +260,12 @@ def fused_attention(q, k, v, mask=None, scale=None, causal=False,
         if key is None:
             raise ValueError("dropout_p > 0 requires a PRNG key")
         kd = jax.random.key_data(key).reshape(-1)
-        seed = lax.bitcast_convert_type(kd[-1:], jnp.int32)
+        kd32 = lax.bitcast_convert_type(kd, jnp.int32).reshape(-1)
+        if kd32.size >= 2:
+            seed = kd32[-2:]
+        else:  # single-word keys (e.g. rbg) zero-pad the first seed word
+            seed = jnp.concatenate([jnp.zeros((1,), jnp.int32), kd32])
     else:
-        seed = jnp.zeros((1,), jnp.int32)
+        seed = jnp.zeros((2,), jnp.int32)
     return _fused(q, k, v, bias, seed, s, float(dropout_p), bool(causal),
                   bool(interpret))

@@ -29,19 +29,43 @@ DTYPE_SIDECAR = "__dtype__:"
 # void ('|V2') records that cannot be loaded back.
 _RAW_VIEWS = {"bfloat16": _np.uint16, "float8_e4m3fn": _np.uint8,
               "float8_e5m2": _np.uint8}
+_RAW_BY_SIZE = {1: _np.uint8, 2: _np.uint16, 4: _np.uint32, 8: _np.uint64}
+
+
+def _loadable_raw_view(name, dtype):
+    """Raw integer view for any void-kind dtype that load_ndarray_dict's
+    `getattr(ml_dtypes, name)` path can restore; None otherwise (so save
+    fails loudly instead of load failing later)."""
+    try:
+        import ml_dtypes
+    except ImportError:
+        return None
+    restored = getattr(ml_dtypes, name, None)
+    if restored is None or _np.dtype(restored) != dtype:
+        return None
+    return _RAW_BY_SIZE.get(dtype.itemsize)
 
 
 def save_ndarray_dict(filename, arrays: dict):
     """Save {name: NDArray|np.ndarray} (parity: mx.nd.save)."""
     out = {}
-    raw_by_size = {1: _np.uint8, 2: _np.uint16, 4: _np.uint32,
-                   8: _np.uint64}
     for k, v in arrays.items():
+        if k.startswith(DTYPE_SIDECAR) or k == FORMAT_KEY:
+            raise MXNetError(
+                f"array name {k!r} collides with the reserved "
+                f"{DTYPE_SIDECAR!r}/{FORMAT_KEY!r} namespace")
         a = _np.asarray(getattr(v, "asnumpy", lambda: v)())
         name = a.dtype.name
         if name in _RAW_VIEWS or a.dtype.kind == "V":
+            # only dtypes load_ndarray_dict can restore (via ml_dtypes) may
+            # take the sidecar path; fail at save time, not load time
+            view = _RAW_VIEWS.get(name) or _loadable_raw_view(name, a.dtype)
+            if view is None:
+                raise MXNetError(
+                    f"cannot serialize array {k!r} of unsupported dtype "
+                    f"{a.dtype} (not an ml_dtypes dtype)")
             out[DTYPE_SIDECAR + k] = _np.asarray(name)
-            a = a.view(_RAW_VIEWS.get(name, raw_by_size[a.dtype.itemsize]))
+            a = a.view(view)
         out[k] = a
     out[FORMAT_KEY] = _np.asarray(FORMAT_VERSION)
     with open(filename, "wb") as f:

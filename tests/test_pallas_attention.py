@@ -83,6 +83,104 @@ def test_2d_mask_canonicalized_on_every_path():
     np.testing.assert_allclose(np.asarray(out2), np.asarray(out4))
 
 
+def _software_keep_mask(key, B, H, Tq, Tk, p_drop):
+    """Materialize the exact mask the interpret-mode kernel draws, by
+    replaying its software PRNG per (batch, head) grid cell."""
+    kd = jax.random.key_data(key).reshape(-1).astype(np.uint32)
+    s0 = np.int32(kd[-2]) if kd.size >= 2 else np.int32(0)
+    s1 = np.int32(kd[-1])
+    thresh = jnp.uint32(min(int(p_drop * 2.0 ** 32), 2 ** 32 - 1))
+    rows = []
+    for b in range(B):
+        row = []
+        for h in range(H):
+            cell = b * H + h
+            bits = pa._software_bits(
+                jnp.uint32(np.uint32(s0)),
+                jnp.uint32(np.uint32(s1 ^ np.int32(cell))),
+                (Tq, Tk))
+            row.append(bits >= thresh)
+        rows.append(jnp.stack(row))
+    return jnp.stack(rows)  # (B, H, Tq, Tk) keep mask
+
+
+def _masked_dropout_attention(q, k, v, keep, p_drop):
+    """XLA reference: softmax attention with an explicitly materialized
+    dropout mask (the oracle for the kernel's regenerate-in-bwd trick)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(q.shape[-1])
+    w = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+    w = jnp.where(keep, w / (1.0 - p_drop), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", w.astype(q.dtype), v)
+
+
+def test_fused_dropout_interpret_determinism():
+    q, k, v = _qkv(B=2, H=2)
+    key = jax.random.PRNGKey(42)
+    o1 = pa.fused_attention(q, k, v, dropout_p=0.3, key=key, interpret=True)
+    o2 = pa.fused_attention(q, k, v, dropout_p=0.3, key=key, interpret=True)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    o3 = pa.fused_attention(q, k, v, dropout_p=0.3,
+                            key=jax.random.PRNGKey(7), interpret=True)
+    assert bool(jnp.any(o1 != o3))
+    # dropout actually dropped something
+    plain = pa.fused_attention(q, k, v, interpret=True)
+    assert bool(jnp.any(o1 != plain))
+
+
+def test_fused_dropout_uses_both_key_words():
+    # keys sharing the final 32-bit word must NOT share a mask (advisor
+    # finding: the old seed kept only kd[-1:])
+    q, k, v = _qkv(B=1, H=1)
+    mk = lambda w0, w1: jax.random.wrap_key_data(  # noqa: E731
+        jnp.asarray([w0, w1], jnp.uint32))
+    o1 = pa.fused_attention(q, k, v, dropout_p=0.3, key=mk(1, 5),
+                            interpret=True)
+    o2 = pa.fused_attention(q, k, v, dropout_p=0.3, key=mk(2, 5),
+                            interpret=True)
+    assert bool(jnp.any(o1 != o2))
+
+
+def test_fused_dropout_forward_matches_materialized_mask():
+    q, k, v = _qkv(B=2, H=2)
+    key = jax.random.PRNGKey(3)
+    p_drop = 0.25
+    keep = _software_keep_mask(key, 2, 2, 64, 64, p_drop)
+    out = pa.fused_attention(q, k, v, dropout_p=p_drop, key=key,
+                             interpret=True)
+    ref = _masked_dropout_attention(q, k, v, keep, p_drop)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fused_dropout_grads_match_materialized_mask():
+    # the load-bearing property: bwd regenerates the SAME mask as fwd, so
+    # gradients must equal those of the mask-materialized XLA reference
+    q, k, v = _qkv(B=2, H=2)
+    key = jax.random.PRNGKey(11)
+    p_drop = 0.25
+    keep = _software_keep_mask(key, 2, 2, 64, 64, p_drop)
+    g1 = jax.grad(lambda *a: pa.fused_attention(
+        *a, dropout_p=p_drop, key=key, interpret=True).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: _masked_dropout_attention(
+        *a, keep, p_drop).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_fused_dropout_interpret_unbiased():
+    q, k, v = _qkv(B=1, H=2)
+    outs = jnp.stack([pa.fused_attention(q, k, v, dropout_p=0.3,
+                                         key=jax.random.PRNGKey(i),
+                                         interpret=True)
+                      for i in range(24)])
+    plain = pa.fused_attention(q, k, v, interpret=True)
+    rel = float(jnp.abs(outs.mean(0) - plain).mean()
+                / jnp.abs(plain).mean())
+    assert rel < 0.25, rel
+
+
 @pytest.mark.skipif(not ON_TPU, reason="hardware PRNG path needs a TPU")
 def test_fused_dropout_on_tpu():
     q, k, v = _qkv(Tq=512, Tk=512, D=64)
