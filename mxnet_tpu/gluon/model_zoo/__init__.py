@@ -1,0 +1,9 @@
+"""Model zoo namespace (reference parity: python/mxnet/gluon/model_zoo/
+— the `vision` submodule with get_model and per-family entry points).
+
+The implementations live in mxnet_tpu.models.vision; this package is the
+reference-compatible import path: `from mxnet_tpu.gluon.model_zoo import
+vision; vision.resnet50_v1b()`.
+"""
+from ...models import vision  # noqa: F401
+from ...models.vision import get_model  # noqa: F401

@@ -23,8 +23,10 @@ __all__ = ["MultiHeadAttention", "PositionwiseFFN",
 class MultiHeadAttention(HybridBlock):
     """Multi-head attention over (B, T, C) inputs.
 
-    attention_impl: 'auto' | 'xla' | 'flash' — 'flash' selects the Pallas
-    kernel on TPU (ops/attention.py); 'auto' picks flash when available.
+    attention_impl: 'auto' | 'xla' | 'fused' | 'flash' | 'ring' — 'fused'
+    is the Pallas whole-row TPU kernel, 'flash' the blockwise O(T) kernel
+    (ops/attention.py), 'ring' the sequence-parallel path over the mesh's
+    "sp" axis (parallel/sp.py); 'auto' picks per platform/shape.
     """
 
     def __init__(self, units, num_heads, dropout=0.0, use_bias=True,

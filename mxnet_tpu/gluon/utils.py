@@ -1,0 +1,100 @@
+"""Gluon utilities (reference parity: python/mxnet/gluon/utils.py —
+split_data, split_and_load, clip_global_norm, check_sha1, download).
+
+TPU note: split_and_load is the reference's manual data-parallel batch
+scatter. On this stack the idiomatic path is a sharded batch over a mesh
+(mxnet_tpu.parallel); split_and_load is kept for script compatibility and
+for genuine multi-device eager use.
+"""
+from __future__ import annotations
+
+import hashlib
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split an NDArray into `num_slice` slices along batch_axis
+    (parity: gluon.utils.split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}; set "
+            "even_split=False to allow uneven slices")
+    step = size // num_slice
+    if not even_split:
+        slices = []
+        for i in range(num_slice):
+            lo = i * step
+            hi = (i + 1) * step if i < num_slice - 1 else size
+            idx = [slice(None)] * data.ndim
+            idx[batch_axis] = slice(lo, hi)
+            slices.append(data[tuple(idx)])
+        return slices
+    out = []
+    for i in range(num_slice):
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(i * step, (i + 1) * step)
+        out.append(data[tuple(idx)])
+    return out
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split data along batch_axis and load each slice onto one context
+    (parity: gluon.utils.split_and_load)."""
+    from ..ndarray import array
+    if not isinstance(data, NDArray):
+        data = array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays in place so the joint L2 norm is at most max_norm;
+    returns the pre-clip global norm (parity: gluon.utils.clip_global_norm)."""
+    import math
+
+    if not arrays:
+        raise MXNetError("clip_global_norm requires at least one array")
+    total = 0.0
+    for a in arrays:
+        n = float((a.astype("float32") ** 2).sum().asscalar())
+        total += n
+    norm = math.sqrt(total)
+    if check_isfinite and not math.isfinite(norm):
+        raise MXNetError(
+            f"global norm is {norm}: gradients contain NaN/Inf "
+            "(set check_isfinite=False to skip the check)")
+    scale = max_norm / (norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._rebind((a * scale)._data)
+    return norm
+
+
+def check_sha1(filename, sha1_hash):
+    """True iff the file's sha1 matches (parity: gluon.utils.check_sha1)."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """De-scoped: this environment has no network egress. Kept so scripts
+    fail with a clear message instead of an AttributeError."""
+    raise MXNetError(
+        "gluon.utils.download is unavailable: the runtime has no network "
+        "access; place files locally and pass local paths instead")

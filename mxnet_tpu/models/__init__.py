@@ -13,3 +13,4 @@ def get_model(name, **kwargs):
 from .bert import (  # noqa: F401,E402
     BertConfig, BertForMaskedLM, BertForPretraining, BertModel,
     bert_base_config, bert_large_config)
+from . import vision  # noqa: F401,E402

@@ -1,0 +1,41 @@
+"""Vision model zoo (reference parity: gluon/model_zoo/vision/__init__.py
+— get_model + the per-family entry points; also exposed as
+mxnet_tpu.gluon.model_zoo.vision)."""
+from ...base import MXNetError
+
+# module refs must be captured before the star imports: `from .alexnet
+# import *` rebinds the name `alexnet` to the entry-point function
+from . import alexnet as _alexnet
+from . import densenet as _densenet
+from . import mobilenet as _mobilenet
+from . import resnet as _resnet
+from . import squeezenet as _squeezenet
+from . import vgg as _vgg
+
+from .alexnet import *  # noqa: F401,F403,E402
+from .densenet import *  # noqa: F401,F403,E402
+from .mobilenet import *  # noqa: F401,F403,E402
+from .resnet import *  # noqa: F401,F403,E402
+from .squeezenet import *  # noqa: F401,F403,E402
+from .vgg import *  # noqa: F401,F403,E402
+
+_models = {}
+for _mod in (_alexnet, _densenet, _mobilenet, _resnet, _squeezenet, _vgg):
+    for _name in _mod.__all__:
+        _obj = getattr(_mod, _name)
+        if callable(_obj) and _name[0].islower() and not \
+                _name.startswith("get_"):
+            _models[_name] = _obj
+
+
+def get_model(name, **kwargs):
+    """Instantiate a zoo model by name (parity: model_zoo.vision.get_model).
+
+    >>> net = get_model('resnet50_v1b', classes=10)
+    """
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError(
+            f"model {name!r} is not in the zoo; options: "
+            f"{sorted(_models)}")
+    return _models[name](**kwargs)

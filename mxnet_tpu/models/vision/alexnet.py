@@ -1,0 +1,44 @@
+"""AlexNet (reference parity: gluon/model_zoo/vision/alexnet.py)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...gluon.block import HybridBlock
+from ...gluon.nn import (Conv2D, Dense, Dropout, Flatten, HybridSequential,
+                         MaxPool2D)
+
+__all__ = ["AlexNet", "alexnet"]
+
+
+class AlexNet(HybridBlock):
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = HybridSequential()
+        self.features.add(Conv2D(64, kernel_size=11, strides=4, padding=2,
+                                 activation="relu"))
+        self.features.add(MaxPool2D(pool_size=3, strides=2))
+        self.features.add(Conv2D(192, kernel_size=5, padding=2,
+                                 activation="relu"))
+        self.features.add(MaxPool2D(pool_size=3, strides=2))
+        self.features.add(Conv2D(384, kernel_size=3, padding=1,
+                                 activation="relu"))
+        self.features.add(Conv2D(256, kernel_size=3, padding=1,
+                                 activation="relu"))
+        self.features.add(Conv2D(256, kernel_size=3, padding=1,
+                                 activation="relu"))
+        self.features.add(MaxPool2D(pool_size=3, strides=2))
+        self.features.add(Flatten())
+        self.features.add(Dense(4096, activation="relu"))
+        self.features.add(Dropout(0.5))
+        self.features.add(Dense(4096, activation="relu"))
+        self.features.add(Dropout(0.5))
+        self.output = Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def alexnet(pretrained=False, **kwargs):
+    if pretrained:
+        raise MXNetError("pretrained weights are not bundled; use "
+                         "load_parameters() with a local file")
+    return AlexNet(**kwargs)

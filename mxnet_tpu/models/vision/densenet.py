@@ -1,0 +1,106 @@
+"""DenseNet (reference parity: gluon/model_zoo/vision/densenet.py —
+densenet121/161/169/201)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...gluon.block import HybridBlock
+from ...gluon.nn import (AvgPool2D, BatchNorm, Conv2D, Dense, Flatten,
+                         GlobalAvgPool2D, HybridConcatenate,
+                         HybridSequential, MaxPool2D)
+from ...ops import nn as _opnn
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "get_densenet"]
+
+densenet_spec = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+}
+
+
+class _Relu(HybridBlock):
+    def forward(self, x):
+        return _opnn.Activation(x, act_type="relu")
+
+
+def _make_dense_layer(growth_rate, bn_size, dropout):
+    new_features = HybridSequential()
+    new_features.add(BatchNorm())
+    new_features.add(_Relu())
+    new_features.add(Conv2D(bn_size * growth_rate, kernel_size=1,
+                            use_bias=False))
+    new_features.add(BatchNorm())
+    new_features.add(_Relu())
+    new_features.add(Conv2D(growth_rate, kernel_size=3, padding=1,
+                            use_bias=False))
+    out = HybridConcatenate(axis=1)
+    out.add(_Identity())
+    out.add(new_features)
+    return out
+
+
+class _Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+
+def _make_transition(num_output_features):
+    out = HybridSequential()
+    out.add(BatchNorm())
+    out.add(_Relu())
+    out.add(Conv2D(num_output_features, kernel_size=1, use_bias=False))
+    out.add(AvgPool2D(pool_size=2, strides=2))
+    return out
+
+
+class DenseNet(HybridBlock):
+    def __init__(self, num_init_features, growth_rate, block_config,
+                 bn_size=4, dropout=0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        self.features = HybridSequential()
+        self.features.add(Conv2D(num_init_features, kernel_size=7,
+                                 strides=2, padding=3, use_bias=False))
+        self.features.add(BatchNorm())
+        self.features.add(_Relu())
+        self.features.add(MaxPool2D(pool_size=3, strides=2, padding=1))
+        num_features = num_init_features
+        for i, num_layers in enumerate(block_config):
+            block = HybridSequential()
+            for _ in range(num_layers):
+                block.add(_make_dense_layer(growth_rate, bn_size, dropout))
+            self.features.add(block)
+            num_features = num_features + num_layers * growth_rate
+            if i != len(block_config) - 1:
+                num_features //= 2
+                self.features.add(_make_transition(num_features))
+        self.features.add(BatchNorm())
+        self.features.add(_Relu())
+        self.features.add(GlobalAvgPool2D())
+        self.features.add(Flatten())
+        self.output = Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def get_densenet(num_layers, pretrained=False, **kwargs):
+    if num_layers not in densenet_spec:
+        raise MXNetError(f"invalid densenet depth {num_layers}; options "
+                         f"{sorted(densenet_spec)}")
+    if pretrained:
+        raise MXNetError("pretrained weights are not bundled; use "
+                         "load_parameters() with a local file")
+    num_init_features, growth_rate, block_config = densenet_spec[num_layers]
+    return DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+
+
+def _entry(depth):
+    def f(**kwargs):
+        return get_densenet(depth, **kwargs)
+    return f
+
+
+densenet121, densenet161, densenet169, densenet201 = (
+    _entry(d) for d in (121, 161, 169, 201))

@@ -1,0 +1,320 @@
+"""ResNet family — v1, v2 (pre-activation) and v1b (GluonCV stride-in-3x3).
+
+Reference parity: python/mxnet/gluon/model_zoo/vision/resnet.py
+(resnet18-152 v1/v2) plus GluonCV's resnet50_v1b (the BASELINE.md img/sec
+workload). TPU-first notes: plain NCHW HybridBlocks — one hybridized trace
+becomes one XLA program, so the whole residual stack fuses into MXU convs
+with elementwise epilogues; no hand scheduling, no cuDNN-style per-layer
+algorithm selection.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...gluon.block import HybridBlock
+from ...gluon.nn import (AvgPool2D, BatchNorm, Conv2D, Dense, Flatten,
+                         GlobalAvgPool2D, HybridSequential, MaxPool2D)
+from ...ops import nn as _opnn
+
+__all__ = ["ResNetV1", "ResNetV2",
+           "BasicBlockV1", "BasicBlockV2", "BottleneckV1", "BottleneckV2",
+           "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
+           "resnet152_v1",
+           "resnet18_v2", "resnet34_v2", "resnet50_v2", "resnet101_v2",
+           "resnet152_v2",
+           "resnet18_v1b", "resnet34_v1b", "resnet50_v1b", "resnet101_v1b",
+           "resnet152_v1b",
+           "get_resnet"]
+
+
+def _conv3x3(channels, stride, in_channels):
+    return Conv2D(channels, kernel_size=3, strides=stride, padding=1,
+                  use_bias=False, in_channels=in_channels)
+
+
+class BasicBlockV1(HybridBlock):
+    """conv3x3-BN-relu-conv3x3-BN + shortcut (reference: BasicBlockV1)."""
+
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.body = HybridSequential()
+        self.body.add(_conv3x3(channels, stride, in_channels))
+        self.body.add(BatchNorm())
+        self.body.add(_Activation("relu"))
+        self.body.add(_conv3x3(channels, 1, channels))
+        self.body.add(BatchNorm())
+        if downsample:
+            self.downsample = HybridSequential()
+            self.downsample.add(Conv2D(channels, kernel_size=1,
+                                       strides=stride, use_bias=False,
+                                       in_channels=in_channels))
+            self.downsample.add(BatchNorm())
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        residual = x
+        x = self.body(x)
+        if self.downsample is not None:
+            residual = self.downsample(residual)
+        return _opnn.Activation(x + residual, act_type="relu")
+
+
+class BottleneckV1(HybridBlock):
+    """1x1-3x3-1x1 bottleneck. stride_in_1x1=True is the classic v1
+    (stride on the first 1x1); False is the v1b/torchvision layout (stride
+    on the 3x3 — what GluonCV's resnet*_v1b and the ImageNet baselines
+    use)."""
+
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 stride_in_1x1=True, **kwargs):
+        super().__init__(**kwargs)
+        s1, s3 = (stride, 1) if stride_in_1x1 else (1, stride)
+        self.body = HybridSequential()
+        self.body.add(Conv2D(channels // 4, kernel_size=1, strides=s1,
+                             use_bias=False))
+        self.body.add(BatchNorm())
+        self.body.add(_Activation("relu"))
+        self.body.add(_conv3x3(channels // 4, s3, channels // 4))
+        self.body.add(BatchNorm())
+        self.body.add(_Activation("relu"))
+        self.body.add(Conv2D(channels, kernel_size=1, strides=1,
+                             use_bias=False))
+        self.body.add(BatchNorm())
+        if downsample:
+            self.downsample = HybridSequential()
+            self.downsample.add(Conv2D(channels, kernel_size=1,
+                                       strides=stride, use_bias=False,
+                                       in_channels=in_channels))
+            self.downsample.add(BatchNorm())
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        residual = x
+        x = self.body(x)
+        if self.downsample is not None:
+            residual = self.downsample(residual)
+        return _opnn.Activation(x + residual, act_type="relu")
+
+
+class BasicBlockV2(HybridBlock):
+    """Pre-activation basic block (reference: BasicBlockV2)."""
+
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.bn1 = BatchNorm()
+        self.conv1 = _conv3x3(channels, stride, in_channels)
+        self.bn2 = BatchNorm()
+        self.conv2 = _conv3x3(channels, 1, channels)
+        if downsample:
+            self.downsample = Conv2D(channels, 1, strides=stride,
+                                     use_bias=False, in_channels=in_channels)
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        residual = x
+        x = self.bn1(x)
+        x = _opnn.Activation(x, act_type="relu")
+        if self.downsample is not None:
+            residual = self.downsample(x)
+        x = self.conv1(x)
+        x = self.bn2(x)
+        x = _opnn.Activation(x, act_type="relu")
+        x = self.conv2(x)
+        return x + residual
+
+
+class BottleneckV2(HybridBlock):
+    """Pre-activation bottleneck (reference: BottleneckV2)."""
+
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.bn1 = BatchNorm()
+        self.conv1 = Conv2D(channels // 4, kernel_size=1, strides=1,
+                            use_bias=False)
+        self.bn2 = BatchNorm()
+        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
+        self.bn3 = BatchNorm()
+        self.conv3 = Conv2D(channels, kernel_size=1, strides=1,
+                            use_bias=False)
+        if downsample:
+            self.downsample = Conv2D(channels, 1, strides=stride,
+                                     use_bias=False, in_channels=in_channels)
+        else:
+            self.downsample = None
+
+    def forward(self, x):
+        residual = x
+        x = self.bn1(x)
+        x = _opnn.Activation(x, act_type="relu")
+        if self.downsample is not None:
+            residual = self.downsample(x)
+        x = self.conv1(x)
+        x = self.bn2(x)
+        x = _opnn.Activation(x, act_type="relu")
+        x = self.conv2(x)
+        x = self.bn3(x)
+        x = _opnn.Activation(x, act_type="relu")
+        x = self.conv3(x)
+        return x + residual
+
+
+class _Activation(HybridBlock):
+    def __init__(self, act, **kwargs):
+        super().__init__(**kwargs)
+        self._act = act
+
+    def forward(self, x):
+        return _opnn.Activation(x, act_type=self._act)
+
+
+class ResNetV1(HybridBlock):
+    """ResNet v1 trunk (reference: ResNetV1). thumbnail=True swaps the
+    7x7/2 + maxpool stem for a 3x3/1 stem (CIFAR-size inputs)."""
+
+    def __init__(self, block, layers, channels, classes=1000,
+                 thumbnail=False, stride_in_1x1=True, **kwargs):
+        super().__init__(**kwargs)
+        assert len(layers) == len(channels) - 1
+        self.features = HybridSequential()
+        if thumbnail:
+            self.features.add(_conv3x3(channels[0], 1, 3))
+        else:
+            self.features.add(Conv2D(channels[0], 7, 2, 3, use_bias=False,
+                                     in_channels=3))
+            self.features.add(BatchNorm())
+            self.features.add(_Activation("relu"))
+            self.features.add(MaxPool2D(3, 2, 1))
+        for i, num_layer in enumerate(layers):
+            stride = 1 if i == 0 else 2
+            self.features.add(self._make_layer(
+                block, num_layer, channels[i + 1], stride,
+                in_channels=channels[i], stride_in_1x1=stride_in_1x1))
+        self.features.add(GlobalAvgPool2D())
+        self.features.add(Flatten())
+        self.output = Dense(classes, in_units=channels[-1])
+
+    def _make_layer(self, block, layers, channels, stride, in_channels=0,
+                    stride_in_1x1=True):
+        kw = {"stride_in_1x1": stride_in_1x1} if block is BottleneckV1 else {}
+        layer = HybridSequential()
+        layer.add(block(channels, stride, channels != in_channels,
+                        in_channels=in_channels, **kw))
+        for _ in range(layers - 1):
+            layer.add(block(channels, 1, False, in_channels=channels, **kw))
+        return layer
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+class ResNetV2(HybridBlock):
+    """Pre-activation ResNet v2 trunk (reference: ResNetV2)."""
+
+    def __init__(self, block, layers, channels, classes=1000,
+                 thumbnail=False, **kwargs):
+        super().__init__(**kwargs)
+        assert len(layers) == len(channels) - 1
+        self.features = HybridSequential()
+        self.features.add(BatchNorm(scale=False, center=False))
+        if thumbnail:
+            self.features.add(_conv3x3(channels[0], 1, 3))
+        else:
+            self.features.add(Conv2D(channels[0], 7, 2, 3, use_bias=False,
+                                     in_channels=3))
+            self.features.add(BatchNorm())
+            self.features.add(_Activation("relu"))
+            self.features.add(MaxPool2D(3, 2, 1))
+        in_channels = channels[0]
+        for i, num_layer in enumerate(layers):
+            stride = 1 if i == 0 else 2
+            self.features.add(self._make_layer(
+                block, num_layer, channels[i + 1], stride,
+                in_channels=in_channels))
+            in_channels = channels[i + 1]
+        self.features.add(BatchNorm())
+        self.features.add(_Activation("relu"))
+        self.features.add(GlobalAvgPool2D())
+        self.features.add(Flatten())
+        self.output = Dense(classes, in_units=in_channels)
+
+    def _make_layer(self, block, layers, channels, stride, in_channels=0):
+        layer = HybridSequential()
+        layer.add(block(channels, stride, channels != in_channels,
+                        in_channels=in_channels))
+        for _ in range(layers - 1):
+            layer.add(block(channels, 1, False, in_channels=channels))
+        return layer
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+# num_layers -> (block-type key, per-stage layer counts, channel schedule)
+resnet_spec = {
+    18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
+    34: ("basic_block", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
+    50: ("bottle_neck", [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
+    101: ("bottle_neck", [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
+    152: ("bottle_neck", [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
+}
+resnet_net_versions = [ResNetV1, ResNetV2]
+resnet_block_versions = [
+    {"basic_block": BasicBlockV1, "bottle_neck": BottleneckV1},
+    {"basic_block": BasicBlockV2, "bottle_neck": BottleneckV2},
+]
+
+
+def get_resnet(version, num_layers, pretrained=False, root=None,
+               stride_in_1x1=None, **kwargs):
+    """Factory (reference: get_resnet). version: 1 or 2. stride_in_1x1
+    defaults to True for plain v1; v1b entry points pass False."""
+    if num_layers not in resnet_spec:
+        raise MXNetError(
+            f"invalid resnet depth {num_layers}; options: "
+            f"{sorted(resnet_spec)}")
+    if pretrained:
+        raise MXNetError(
+            "pretrained weights are not bundled (no model store in this "
+            "environment); use load_parameters()/load_mxnet_params() with a "
+            "locally supplied .params file")
+    if version not in (1, 2):
+        raise MXNetError(f"invalid resnet version {version}; options: 1, 2")
+    block_type, layers, channels = resnet_spec[num_layers]
+    net_cls = resnet_net_versions[version - 1]
+    block_cls = resnet_block_versions[version - 1][block_type]
+    if version == 1 and block_type == "bottle_neck":
+        kwargs["stride_in_1x1"] = (True if stride_in_1x1 is None
+                                   else stride_in_1x1)
+    return net_cls(block_cls, layers, channels, **kwargs)
+
+
+def _entry(version, depth, **fixed):
+    def f(**kwargs):
+        kwargs.update(fixed)
+        return get_resnet(version, depth, **kwargs)
+    return f
+
+
+resnet18_v1 = _entry(1, 18)
+resnet34_v1 = _entry(1, 34)
+resnet50_v1 = _entry(1, 50)
+resnet101_v1 = _entry(1, 101)
+resnet152_v1 = _entry(1, 152)
+resnet18_v2 = _entry(2, 18)
+resnet34_v2 = _entry(2, 34)
+resnet50_v2 = _entry(2, 50)
+resnet101_v2 = _entry(2, 101)
+resnet152_v2 = _entry(2, 152)
+# v1b (GluonCV): bottleneck stride moves to the 3x3 conv
+resnet18_v1b = _entry(1, 18)
+resnet34_v1b = _entry(1, 34)
+resnet50_v1b = _entry(1, 50, stride_in_1x1=False)
+resnet101_v1b = _entry(1, 101, stride_in_1x1=False)
+resnet152_v1b = _entry(1, 152, stride_in_1x1=False)

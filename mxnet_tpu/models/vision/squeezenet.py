@@ -1,0 +1,100 @@
+"""SqueezeNet 1.0/1.1 (reference parity:
+gluon/model_zoo/vision/squeezenet.py)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...gluon.block import HybridBlock
+from ...gluon.nn import (AvgPool2D, Conv2D, Dropout, Flatten,
+                         HybridConcatenate, HybridSequential, MaxPool2D)
+from ...ops import nn as _opnn
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1", "get_squeezenet"]
+
+
+class _Relu(HybridBlock):
+    def forward(self, x):
+        return _opnn.Activation(x, act_type="relu")
+
+
+def _make_fire_conv(channels, kernel_size, padding=0):
+    out = HybridSequential()
+    out.add(Conv2D(channels, kernel_size, padding=padding))
+    out.add(_Relu())
+    return out
+
+
+def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
+    out = HybridSequential()
+    out.add(_make_fire_conv(squeeze_channels, 1))
+    paths = HybridConcatenate(axis=1)
+    paths.add(_make_fire_conv(expand1x1_channels, 1))
+    paths.add(_make_fire_conv(expand3x3_channels, 3, 1))
+    out.add(paths)
+    return out
+
+
+class SqueezeNet(HybridBlock):
+    def __init__(self, version, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        if version not in ("1.0", "1.1"):
+            raise MXNetError(f"unsupported squeezenet version {version}: "
+                             "1.0 or 1.1 expected")
+        self.features = HybridSequential()
+        if version == "1.0":
+            self.features.add(Conv2D(96, kernel_size=7, strides=2))
+            self.features.add(_Relu())
+            self.features.add(MaxPool2D(pool_size=3, strides=2,
+                                        ceil_mode=True))
+            self.features.add(_make_fire(16, 64, 64))
+            self.features.add(_make_fire(16, 64, 64))
+            self.features.add(_make_fire(32, 128, 128))
+            self.features.add(MaxPool2D(pool_size=3, strides=2,
+                                        ceil_mode=True))
+            self.features.add(_make_fire(32, 128, 128))
+            self.features.add(_make_fire(48, 192, 192))
+            self.features.add(_make_fire(48, 192, 192))
+            self.features.add(_make_fire(64, 256, 256))
+            self.features.add(MaxPool2D(pool_size=3, strides=2,
+                                        ceil_mode=True))
+            self.features.add(_make_fire(64, 256, 256))
+        else:
+            self.features.add(Conv2D(64, kernel_size=3, strides=2))
+            self.features.add(_Relu())
+            self.features.add(MaxPool2D(pool_size=3, strides=2,
+                                        ceil_mode=True))
+            self.features.add(_make_fire(16, 64, 64))
+            self.features.add(_make_fire(16, 64, 64))
+            self.features.add(MaxPool2D(pool_size=3, strides=2,
+                                        ceil_mode=True))
+            self.features.add(_make_fire(32, 128, 128))
+            self.features.add(_make_fire(32, 128, 128))
+            self.features.add(MaxPool2D(pool_size=3, strides=2,
+                                        ceil_mode=True))
+            self.features.add(_make_fire(48, 192, 192))
+            self.features.add(_make_fire(48, 192, 192))
+            self.features.add(_make_fire(64, 256, 256))
+            self.features.add(_make_fire(64, 256, 256))
+        self.features.add(Dropout(0.5))
+        self.output = HybridSequential()
+        self.output.add(Conv2D(classes, kernel_size=1))
+        self.output.add(_Relu())
+        self.output.add(AvgPool2D(13))
+        self.output.add(Flatten())
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def get_squeezenet(version, pretrained=False, **kwargs):
+    if pretrained:
+        raise MXNetError("pretrained weights are not bundled; use "
+                         "load_parameters() with a local file")
+    return SqueezeNet(version, **kwargs)
+
+
+def squeezenet1_0(**kwargs):
+    return get_squeezenet("1.0", **kwargs)
+
+
+def squeezenet1_1(**kwargs):
+    return get_squeezenet("1.1", **kwargs)

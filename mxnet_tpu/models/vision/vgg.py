@@ -1,0 +1,70 @@
+"""VGG family (reference parity: gluon/model_zoo/vision/vgg.py — vgg11-19
+with and without BatchNorm)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...gluon.block import HybridBlock
+from ...gluon.nn import (BatchNorm, Conv2D, Dense, Dropout,
+                         HybridSequential, MaxPool2D)
+
+__all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+           "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn", "get_vgg"]
+
+vgg_spec = {
+    11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+    13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+    16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+    19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512]),
+}
+
+
+class VGG(HybridBlock):
+    def __init__(self, layers, filters, classes=1000, batch_norm=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        assert len(layers) == len(filters)
+        self.features = HybridSequential()
+        for i, num in enumerate(layers):
+            for _ in range(num):
+                self.features.add(Conv2D(filters[i], kernel_size=3,
+                                         padding=1))
+                if batch_norm:
+                    self.features.add(BatchNorm())
+                self.features.add(_Relu())
+            self.features.add(MaxPool2D(strides=2))
+        self.features.add(Dense(4096, activation="relu"))
+        self.features.add(Dropout(0.5))
+        self.features.add(Dense(4096, activation="relu"))
+        self.features.add(Dropout(0.5))
+        self.output = Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+class _Relu(HybridBlock):
+    def forward(self, x):
+        from ...ops import nn as _opnn
+        return _opnn.Activation(x, act_type="relu")
+
+
+def get_vgg(num_layers, pretrained=False, batch_norm=False, **kwargs):
+    if num_layers not in vgg_spec:
+        raise MXNetError(f"invalid vgg depth {num_layers}; options "
+                         f"{sorted(vgg_spec)}")
+    if pretrained:
+        raise MXNetError("pretrained weights are not bundled; use "
+                         "load_parameters() with a local file")
+    layers, filters = vgg_spec[num_layers]
+    return VGG(layers, filters, batch_norm=batch_norm, **kwargs)
+
+
+def _entry(depth, bn=False):
+    def f(**kwargs):
+        return get_vgg(depth, batch_norm=bn, **kwargs)
+    return f
+
+
+vgg11, vgg13, vgg16, vgg19 = (_entry(d) for d in (11, 13, 16, 19))
+vgg11_bn, vgg13_bn, vgg16_bn, vgg19_bn = (
+    _entry(d, bn=True) for d in (11, 13, 16, 19))

@@ -132,7 +132,11 @@ def ring_attention_data(q, k, v, axis_name, causal=False, scale=None,
     rotates around the ring via ppermute, online-softmax combining per hop
     (Liu et al.; SURVEY.md §5.7). causal masking uses global positions, so
     callers must shard the sequence contiguously (block i = positions
-    [i*T_local, (i+1)*T_local))."""
+    [i*T_local, (i+1)*T_local)).
+
+    mask: optional LOCAL key-padding block of shape (B, T_local), True =
+    attend — the caller's (B, Tk) global mask sharded along Tk; it rotates
+    around the ring alongside its KV block."""
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     n = lax.psum(1, axis_name)
@@ -140,9 +144,10 @@ def ring_attention_data(q, k, v, axis_name, causal=False, scale=None,
     B, H, T, D = q.shape
     q32 = q.astype(jnp.float32)
     q_pos = idx * T + jnp.arange(T)
+    perm = [(i, (i + 1) % n) for i in range(n)]
 
     def hop(carry, hop_i):
-        acc, row_max, row_sum, k_cur, v_cur = carry
+        acc, row_max, row_sum, k_cur, v_cur, m_cur = carry
         src_idx = (idx - hop_i) % n  # whose block we currently hold
         logits = jnp.einsum("bhqd,bhkd->bhqk", q32,
                             k_cur.astype(jnp.float32)) * s
@@ -150,6 +155,8 @@ def ring_attention_data(q, k, v, axis_name, causal=False, scale=None,
             kpos = src_idx * T + jnp.arange(T)
             cm = q_pos[None, None, :, None] >= kpos[None, None, None, :]
             logits = jnp.where(cm, logits, NEG_INF)
+        if m_cur is not None:
+            logits = jnp.where(m_cur[:, None, None, :], logits, NEG_INF)
         blk_max = jnp.max(logits, axis=-1)
         new_max = jnp.maximum(row_max, blk_max)
         corr = jnp.exp(row_max - new_max)
@@ -160,15 +167,16 @@ def ring_attention_data(q, k, v, axis_name, causal=False, scale=None,
         row_sum = row_sum * corr + jnp.sum(p, axis=-1)
         acc = acc * corr[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
-        perm = [(i, (i + 1) % n) for i in range(n)]
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return (acc, new_max, row_sum, k_nxt, v_nxt), None
+        m_nxt = (lax.ppermute(m_cur, axis_name, perm)
+                 if m_cur is not None else None)
+        return (acc, new_max, row_sum, k_nxt, v_nxt, m_nxt), None
 
     acc0 = jnp.zeros((B, H, T, D), jnp.float32)
     max0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
     sum0 = jnp.zeros((B, H, T), jnp.float32)
-    (acc, _, row_sum, _, _), _ = lax.scan(
-        hop, (acc0, max0, sum0, k, v), jnp.arange(n))
+    (acc, _, row_sum, _, _, _), _ = lax.scan(
+        hop, (acc0, max0, sum0, k, v, mask), jnp.arange(n))
     out = acc / jnp.maximum(row_sum[..., None], 1e-30)
     return out.astype(q.dtype)

@@ -674,6 +674,18 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
         # (B, Tk) key-padding → canonical (B, 1, 1, Tk) for every path
         mask = mask[:, None, None, :]
     train_drop = dropout_p > 0 and is_training()
+    if impl == "ring":
+        # sequence-parallel path: T sharded over the mesh's "sp" axis,
+        # KV blocks rotating via ppermute (parallel/sp.py; SURVEY.md §5.7)
+        from ..parallel import sp as _sp
+        if train_drop:
+            raise MXNetError(
+                "impl='ring' does not support attention-probability "
+                "dropout (the mask would need to be consistent across "
+                "ring hops); set attention dropout to 0 under sequence "
+                "parallelism")
+        return _sp.ring_attention(q, k, v, mask=mask, causal=causal,
+                                  scale=scale)
     if impl in ("auto", "fused"):
         from . import pallas_attention as _pa
         on_tpu = _target_platform(q) == "tpu"

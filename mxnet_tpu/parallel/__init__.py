@@ -9,5 +9,6 @@ from .mesh import (  # noqa: F401
     NamedSharding, PartitionSpec, current_mesh, make_mesh, mesh_scope,
     named_sharding, set_default_mesh)
 from .rules import (  # noqa: F401
-    ShardingRules, apply_sharding_rules, megatron_dense_rules)
+    ShardingRules, apply_sharding_rules, fsdp_rules, megatron_dense_rules)
+from .sp import ring_attention, sp_enabled  # noqa: F401
 from .step import EvalStep, TrainStep  # noqa: F401

@@ -14,7 +14,8 @@ import re
 from ..base import MXNetError
 from .mesh import PartitionSpec
 
-__all__ = ["ShardingRules", "apply_sharding_rules", "megatron_dense_rules"]
+__all__ = ["ShardingRules", "apply_sharding_rules", "megatron_dense_rules",
+           "fsdp_rules"]
 
 
 class ShardingRules:
@@ -81,3 +82,36 @@ def megatron_dense_rules(tp_axis="tp", fsdp_axis=None):
     if fsdp_axis is not None:
         rules.default = None  # leave rest replicated; fsdp via explicit specs
     return rules
+
+
+def fsdp_rules(fsdp_axis="fsdp", min_size=1024):
+    """ZeRO-3-style fully-sharded data parallelism: every parameter's
+    LARGEST dim shards over `fsdp_axis`; XLA's SPMD partitioner inserts the
+    all-gather before use and reduce-scatters the gradients (the TPU-native
+    equivalent of the reference-absent ZeRO sharded optimizer, SURVEY.md
+    §2.4 presence matrix).
+
+    min_size: parameters with fewer elements stay replicated (tiny biases/
+    norms cost more in collective latency than they save in HBM).
+    Shape-aware, so it is implemented as a ShardingRules subclass whose
+    spec_for consults the parameter shape."""
+
+    class _FsdpRules(ShardingRules):
+        def spec_for(self, name, shape=None):
+            # explicit rules (added by the caller) take precedence
+            spec = super().spec_for(name, shape)
+            if spec is not None:
+                return spec
+            if shape is None or not shape or any(d == 0 for d in shape):
+                return None
+            n = 1
+            for d in shape:
+                n *= d
+            if n < min_size:
+                return None
+            big = max(range(len(shape)), key=lambda i: shape[i])
+            parts = [None] * len(shape)
+            parts[big] = fsdp_axis
+            return PartitionSpec(*parts)
+
+    return _FsdpRules()

@@ -1,0 +1,81 @@
+"""Sequence/context parallelism: ring attention over the mesh's "sp" axis.
+
+Reference parity: none — SURVEY.md §5.7 records that the reference has no
+sequence-dimension sharding of any kind; the task brief makes it
+first-class here. Design: the (B, H, T, D) attention operands enter
+sharded along T over "sp"; a shard_map runs ops.attention.
+ring_attention_data per shard, rotating KV (and the key-padding mask)
+around the ring with lax.ppermute while accumulating online-softmax
+statistics — O(T_local) memory per device and pure ICI traffic, composing
+under an outer pjit with dp/tp axes.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import PartitionSpec as P
+try:  # jax >= 0.4.35
+    from jax.experimental.shard_map import shard_map
+except ImportError:  # pragma: no cover - newer jax moved it
+    from jax.sharding import shard_map
+
+from ..base import MXNetError
+from ..ops.attention import ring_attention_data
+from .mesh import AXIS_SP, current_mesh
+
+__all__ = ["ring_attention", "sp_enabled"]
+
+
+def sp_enabled(mesh=None, sp_axis=AXIS_SP):
+    """True iff an active mesh has a real (size > 1) sp axis."""
+    mesh = mesh if mesh is not None else current_mesh()
+    return (mesh is not None and sp_axis in mesh.axis_names
+            and mesh.shape[sp_axis] > 1)
+
+
+def ring_attention(q, k, v, mask=None, causal=False, scale=None, mesh=None,
+                   sp_axis=AXIS_SP, batch_axis="dp", heads_axis="tp"):
+    """Sequence-parallel attention on (B, H, T, D) jax arrays.
+
+    The sequence dim shards over `sp_axis`; batch shards over `batch_axis`
+    and heads over `heads_axis` when those axes exist in the mesh (matching
+    the activation layout megatron_dense_rules produces, so no resharding
+    is inserted around the shard_map). mask: optional key-padding mask,
+    (B, Tk) or (B, 1, 1, Tk), True = attend.
+    """
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None or sp_axis not in mesh.axis_names:
+        raise MXNetError(
+            f"ring attention needs an active mesh with a {sp_axis!r} axis "
+            "(make_mesh(sp=...) + mesh_scope/set_default_mesh)")
+    n_sp = mesh.shape[sp_axis]
+    B, H, T, D = q.shape
+    if T % n_sp or k.shape[-2] % n_sp:
+        raise MXNetError(
+            f"sequence length {T}/{k.shape[-2]} not divisible by sp axis "
+            f"size {n_sp}")
+    ba = batch_axis if batch_axis in mesh.axis_names else None
+    ha = heads_axis if heads_axis in mesh.axis_names else None
+    qspec = P(ba, ha, sp_axis, None)
+    in_specs = [qspec, qspec, qspec]
+    args = [q, k, v]
+    if mask is not None:
+        mask2 = mask.reshape(mask.shape[0], mask.shape[-1])
+        if mask2.shape[0] != B:  # broadcastable (1, Tk) masks
+            import jax.numpy as jnp
+            mask2 = jnp.broadcast_to(mask2, (B, mask2.shape[-1]))
+        in_specs.append(P(ba, sp_axis))
+        args.append(mask2)
+
+        def local(qb, kb, vb, mb):
+            return ring_attention_data(qb, kb, vb, sp_axis, causal=causal,
+                                       scale=scale, mask=mb)
+    else:
+        def local(qb, kb, vb):
+            return ring_attention_data(qb, kb, vb, sp_axis, causal=causal,
+                                       scale=scale)
+
+    fn = shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                   out_specs=qspec, check_rep=False)
+    return fn(*args)

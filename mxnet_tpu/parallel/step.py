@@ -89,6 +89,7 @@ class TrainStep:
         self._lr_cache = None
         self._wd_cache = None
         self._jitted = None
+        self._lower_args = None
         self._meta = {}
         if self.mesh is not None:
             self._place_sharded()
@@ -227,6 +228,13 @@ class TrainStep:
                 datas = tuple(
                     jax.device_put(d, named_sharding(s))
                     for d, s in zip(datas, bspecs))
+        if self._lower_args is None:
+            # shape structs for AOT lowering (compiled_cost_analysis);
+            # can't keep the real arrays — they are donated below
+            self._lower_args = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                (tuple(self._param_arrays), self._opt_states, self._t,
+                 key, lr, wd) + datas)
         out = self._jitted(tuple(self._param_arrays), self._opt_states,
                            self._t, key, lr, wd, *datas)
         self._param_arrays, self._opt_states, self._t, loss, aux = out
@@ -246,6 +254,22 @@ class TrainStep:
     @property
     def step_count(self):
         return self._host_t
+
+    def compiled_cost_analysis(self):
+        """XLA's cost analysis for the compiled step program (a dict with
+        'flops' etc.), or None before the first call / when the backend
+        does not report costs. This is the authoritative per-step flop
+        count for MFU math — no hand-derived estimates."""
+        if self._jitted is None or self._lower_args is None:
+            return None
+        try:
+            compiled = self._jitted.lower(*self._lower_args).compile()
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else None
+            return ca
+        except Exception:
+            return None
 
 
 class EvalStep:

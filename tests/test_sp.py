@@ -1,0 +1,167 @@
+"""Sequence parallelism (ring attention) and FSDP sharding tests on the
+8-device virtual CPU mesh (SURVEY.md §4: the multi-process-on-one-box
+distributed test pattern, done mesh-style).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel as par
+from mxnet_tpu.ops.nn import dot_product_attention as dpa
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh")
+
+
+def _qkv(B=2, H=4, T=32, D=8, seed=0):
+    r = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(r.standard_normal((B, H, T, D)),  # noqa: E731
+                             jnp.float32)
+    return mk(), mk(), mk()
+
+
+def _ref(q, k, v, mask=None, causal=False):
+    return dpa.raw_fn(q, k, v, mask=mask, causal=causal, impl="xla")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_single_device(causal):
+    q, k, v = _qkv()
+    mesh = par.make_mesh(sp=4, devices=jax.devices()[:4])
+    with par.mesh_scope(mesh):
+        out = par.ring_attention(q, k, v, causal=causal)
+    ref = _ref(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_key_padding_mask():
+    q, k, v = _qkv()
+    r = np.random.default_rng(1)
+    mask = jnp.asarray(r.random((2, 32)) > 0.3)
+    mesh = par.make_mesh(sp=4, devices=jax.devices()[:4])
+    with par.mesh_scope(mesh):
+        out = par.ring_attention(q, k, v, mask=mask)
+    ref = _ref(q, k, v, mask=mask[:, None, None, :])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_fully_masked_rows_zero():
+    q, k, v = _qkv(B=1)
+    mask = jnp.zeros((1, 32), bool)
+    mesh = par.make_mesh(sp=4, devices=jax.devices()[:4])
+    with par.mesh_scope(mesh):
+        out = par.ring_attention(q, k, v, mask=mask)
+    np.testing.assert_array_equal(np.asarray(out), 0.0)
+
+
+def test_ring_gradients_match():
+    q, k, v = _qkv()
+    mesh = par.make_mesh(sp=4, devices=jax.devices()[:4])
+
+    def f_ring(q, k, v):
+        with par.mesh_scope(mesh):
+            return par.ring_attention(q, k, v, causal=True).sum()
+
+    def f_ref(q, k, v):
+        return _ref(q, k, v, causal=True).sum()
+
+    g1 = jax.grad(f_ring, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_ring_via_op_impl():
+    """The user-facing route: mx.nd.dot_product_attention(impl='ring')."""
+    q, k, v = _qkv()
+    mesh = par.make_mesh(dp=2, sp=4)
+    with par.mesh_scope(mesh):
+        out = mx.nd.dot_product_attention(
+            mx.nd.NDArray(q), mx.nd.NDArray(k), mx.nd.NDArray(v),
+            impl="ring")
+    ref = _ref(q, k, v)
+    np.testing.assert_allclose(out.asnumpy(), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    assert par.sp_enabled(mesh)
+
+
+def test_ring_requires_sp_axis():
+    q, k, v = _qkv()
+    mesh = par.make_mesh(dp=8)
+    with par.mesh_scope(mesh):
+        with pytest.raises(mx.base.MXNetError):
+            par.ring_attention(q, k, v)
+
+
+def test_ring_rejects_dropout_in_training():
+    from mxnet_tpu import autograd
+    q, k, v = _qkv()
+    mesh = par.make_mesh(sp=4, devices=jax.devices()[:4])
+    nq = mx.nd.NDArray(q)
+    nq.attach_grad()
+    with par.mesh_scope(mesh):
+        with autograd.record():
+            with pytest.raises(mx.base.MXNetError):
+                mx.nd.dot_product_attention(
+                    nq, mx.nd.NDArray(k), mx.nd.NDArray(v),
+                    impl="ring", dropout_p=0.1)
+
+
+def _train_bert_steps(mesh, rules, n_steps=3):
+    """Tiny BERT trained for n_steps under the given mesh/rules; returns
+    the loss trajectory (the fsdp==replicated equivalence oracle)."""
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.models import BertConfig, BertForMaskedLM
+    from mxnet_tpu.parallel import PartitionSpec as P
+
+    rng = np.random.default_rng(0)
+    cfg = BertConfig(vocab_size=64, units=32, hidden_size=64, num_layers=2,
+                     num_heads=2, max_length=32, dropout=0.0,
+                     attention_dropout=0.0)
+    net = BertForMaskedLM(cfg)
+    mx.rng.seed(7)
+    net.initialize(mx.init.Normal(0.02))
+    if rules is not None:
+        par.apply_sharding_rules(net, rules)
+    o = opt.AdamW(learning_rate=1e-3, wd=0.01)
+    lfn = gloss.SoftmaxCrossEntropyLoss()
+    step = par.TrainStep(net, lfn, o, mesh=mesh, n_net_inputs=4,
+                         batch_specs=(P("dp"), P("dp"), P("dp"), P("dp"),
+                                      P("dp")))
+    batch, seq_len, n_masked = 4, 16, 4
+    ids = mx.nd.array(rng.integers(0, 64, (batch, seq_len)), dtype="int32")
+    tt = mx.nd.array(np.zeros((batch, seq_len)), dtype="int32")
+    vl = mx.nd.array(np.full((batch,), seq_len), dtype="int32")
+    pos = mx.nd.array(
+        np.sort(np.argsort(rng.random((batch, seq_len)))[:, :n_masked]),
+        dtype="int32")
+    labels = mx.nd.array(rng.integers(0, 64, (batch, n_masked)),
+                         dtype="int32")
+    return [float(step(ids, tt, vl, pos, labels).asscalar())
+            for _ in range(n_steps)]
+
+
+def test_fsdp_matches_replicated():
+    """ZeRO-style fsdp sharding must not change training numerics."""
+    mesh_r = par.make_mesh(dp=2, fsdp=2, devices=jax.devices()[:4])
+    losses_repl = _train_bert_steps(mesh_r, rules=None)
+    mesh_f = par.make_mesh(dp=2, fsdp=2, devices=jax.devices()[:4])
+    losses_fsdp = _train_bert_steps(mesh_f, rules=par.fsdp_rules(min_size=8))
+    np.testing.assert_allclose(losses_fsdp, losses_repl, rtol=2e-5,
+                               atol=1e-6)
+
+
+def test_fsdp_rules_shard_largest_dim():
+    rules = par.fsdp_rules(min_size=4)
+    spec = rules.spec_for("encoder.layer0.fc1.weight", (64, 32))
+    assert tuple(spec) == ("fsdp", None)
+    spec = rules.spec_for("embed.weight", (32, 128))
+    assert tuple(spec) == (None, "fsdp")
+    assert rules.spec_for("ln.gamma", (2,)) is None  # below min_size

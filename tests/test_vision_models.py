@@ -1,0 +1,118 @@
+"""Vision model zoo tests.
+
+Parity model: the reference's tests/python/unittest/test_gluon_model_zoo.py
+(zoo instantiation + forward shapes) and tests/python/train/test_conv.py
+(small end-to-end convergence smoke — catch integration bugs unit tests
+miss, SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def test_get_model_registry():
+    assert len(vision._models) >= 30
+    with pytest.raises(mx.base.MXNetError):
+        vision.get_model("resnet999_v9")
+    with pytest.raises(mx.base.MXNetError):
+        vision.get_model("resnet50_v1", pretrained=True)
+
+
+@pytest.mark.parametrize("name,kwargs", [
+    ("resnet18_v1", {"thumbnail": True}),
+    ("resnet34_v2", {"thumbnail": True}),
+    ("resnet50_v1", {"thumbnail": True}),
+    ("resnet50_v1b", {"thumbnail": True}),
+])
+def test_resnet_forward_thumbnail(name, kwargs):
+    net = vision.get_model(name, classes=10, **kwargs)
+    net.initialize()
+    x = mx.nd.array(np.random.randn(2, 3, 32, 32).astype("float32"))
+    out = net(x)
+    assert out.shape == (2, 10)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+@pytest.mark.parametrize("name", [
+    "alexnet", "squeezenet1_1", "mobilenet0_25", "mobilenet_v2_0_25",
+])
+def test_zoo_forward_224(name):
+    net = vision.get_model(name, classes=7)
+    net.initialize()
+    x = mx.nd.array(np.random.randn(1, 3, 224, 224).astype("float32"))
+    out = net(x)
+    assert out.shape == (1, 7)
+
+
+def test_resnet_v1b_stride_placement():
+    """v1b puts the stride on the 3x3 (GluonCV layout): same param count,
+    different spatial reduction order — check the 3x3 conv stride."""
+    net_b = vision.resnet50_v1b(classes=10)
+    blk = net_b.features._children["4"]._children["0"]  # stage2 first block
+    conv3x3 = blk.body._children["3"]
+    assert conv3x3._kernel == (3, 3)
+    # stage 2 downsamples: stride must sit on the 3x3, not the first 1x1
+    assert conv3x3._strides == (2, 2) or blk.body._children[
+        "0"]._strides == (1, 1)
+
+
+def test_resnet_hybridize_agreement():
+    net = vision.resnet18_v1(classes=10, thumbnail=True)
+    net.initialize()
+    x = mx.nd.array(np.random.randn(2, 3, 32, 32).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    traced = net(x).asnumpy()
+    np.testing.assert_allclose(eager, traced, rtol=1e-5, atol=1e-5)
+
+
+def test_resnet_save_load_roundtrip(tmp_path):
+    net = vision.resnet18_v1(classes=10, thumbnail=True)
+    net.initialize()
+    x = mx.nd.array(np.random.randn(2, 3, 32, 32).astype("float32"))
+    ref = net(x).asnumpy()
+    f = str(tmp_path / "r18.npz")
+    net.save_parameters(f)
+    net2 = vision.resnet18_v1(classes=10, thumbnail=True)
+    net2.load_parameters(f)
+    np.testing.assert_allclose(net2(x).asnumpy(), ref, rtol=1e-6)
+
+
+def test_resnet_trains_to_accuracy():
+    """End-to-end convergence smoke (parity: tests/python/train/test_conv.py
+    — MNIST to ~98% in seconds; here a synthetic separable 4-class problem
+    that a thumbnail ResNet-18 must overfit quickly)."""
+    rng = np.random.default_rng(0)
+    n, classes = 64, 4
+    labels = rng.integers(0, classes, n)
+    # class-dependent mean patches make the task linearly separable
+    means = rng.standard_normal((classes, 3, 1, 1)).astype("float32") * 3.0
+    imgs = (rng.standard_normal((n, 3, 12, 12)).astype("float32")
+            + means[labels])
+    X, Y = mx.nd.array(imgs), mx.nd.array(labels)
+
+    net = vision.resnet18_v1(classes=classes, thumbnail=True)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    lfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    first = None
+    out = None
+    for epoch in range(10):
+        with autograd.record():
+            out = net(X)
+            loss = lfn(out, Y).mean()
+        loss.backward()
+        trainer.step(1)
+        if first is None:
+            first = float(loss.asscalar())
+    # train-mode (batch-stat) accuracy: running stats need ~50 updates at
+    # momentum 0.9 to catch up, which is eval-lag, not non-convergence
+    acc = float((out.asnumpy().argmax(1) == labels).mean())
+    final = float(loss.asscalar())
+    assert final < first * 0.5, (first, final)
+    assert acc > 0.9, acc
