@@ -658,22 +658,51 @@ def _target_platform(x):
             pass
     return jax.default_backend()
 
+def _ring_auto_ok(q, k, mask, train_drop):
+    """True when impl='auto' should route to ring attention: an active
+    mesh with a real sp axis (SURVEY.md §5.7: 'selected by mesh axis
+    mapping — no model-code changes'), self-attention shapes divisible by
+    the mesh axes, no attention-prob dropout, and a key-padding-style
+    mask (the only kind the ring rotates)."""
+    from ..parallel.mesh import AXIS_SP, current_mesh
+    from ..parallel.sp import sp_enabled
+    mesh = current_mesh()
+    if train_drop or not sp_enabled(mesh):
+        return False
+    n_sp = mesh.shape[AXIS_SP]
+    B, H, Tq, _ = q.shape
+    Tk = k.shape[-2]
+    if Tq != Tk or Tq % n_sp:
+        return False
+    if mask is not None and (mask.shape[1] != 1 or mask.shape[-2] != 1):
+        return False  # per-query masks don't rotate; key padding only
+    for ax, dim in (("dp", B), ("tp", H)):
+        if ax in mesh.axis_names and dim % mesh.shape[ax]:
+            return False
+    return True
+
+
 @op("dot_product_attention")
 def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
                           dropout_p=0.0, impl="auto"):
-    """q,k,v: (B, H, T, D). impl: 'auto'|'xla'|'fused'|'flash'.
+    """q,k,v: (B, H, T, D). impl: 'auto'|'xla'|'fused'|'flash'|'ring'.
 
     'fused' is the Pallas TPU kernel (ops/pallas_attention.py): whole-row
     softmax→dropout→PV in VMEM with the dropout mask drawn from the
     on-core hardware PRNG — the hot path for T <= 1024 (BERT/GPT-2
     shapes), with or without dropout. 'flash' is the blockwise O(T)
-    kernel in ops/attention.py for long sequences; 'auto' picks fused on
-    TPU when shapes allow, flash for long no-dropout sequences, else one
-    XLA softmax-attention. Fully-masked rows yield zeros on every path."""
+    kernel in ops/attention.py for long sequences; 'ring' the
+    sequence-parallel path. 'auto' picks ring whenever the active mesh
+    has a real sp axis and shapes/dropout allow (so sequence parallelism
+    needs no model-code changes), else fused on TPU when shapes allow,
+    flash for long no-dropout sequences, else one XLA softmax-attention.
+    Fully-masked rows yield zeros on every path."""
     if mask is not None and mask.ndim == 2:
         # (B, Tk) key-padding → canonical (B, 1, 1, Tk) for every path
         mask = mask[:, None, None, :]
     train_drop = dropout_p > 0 and is_training()
+    if impl == "auto" and _ring_auto_ok(q, k, mask, train_drop):
+        impl = "ring"
     if impl == "ring":
         # sequence-parallel path: T sharded over the mesh's "sp" axis,
         # KV blocks rotating via ppermute (parallel/sp.py; SURVEY.md §5.7)

@@ -21,6 +21,8 @@ trace side-channel, dropout draws from a per-step key.
 """
 from __future__ import annotations
 
+from contextlib import nullcontext as _nullcontext
+
 import jax
 import jax.numpy as jnp
 
@@ -235,8 +237,11 @@ class TrainStep:
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                 (tuple(self._param_arrays), self._opt_states, self._t,
                  key, lr, wd) + datas)
-        out = self._jitted(tuple(self._param_arrays), self._opt_states,
-                           self._t, key, lr, wd, *datas)
+        # trace (first call) must see the mesh: ops like attention
+        # impl='auto' consult current_mesh() to pick the sp/ring path
+        with self._mesh_ctx():
+            out = self._jitted(tuple(self._param_arrays), self._opt_states,
+                               self._t, key, lr, wd, *datas)
         self._param_arrays, self._opt_states, self._t, loss, aux = out
         self._host_t += 1  # mirror of t — no device fetch in the hot loop
         self.optimizer.num_update = self._host_t
@@ -263,13 +268,23 @@ class TrainStep:
         if self._jitted is None or self._lower_args is None:
             return None
         try:
-            compiled = self._jitted.lower(*self._lower_args).compile()
+            compiled = self._lowered().compile()
             ca = compiled.cost_analysis()
             if isinstance(ca, (list, tuple)):
                 ca = ca[0] if ca else None
             return ca
         except Exception:
             return None
+
+    def _mesh_ctx(self):
+        return mesh_scope(self.mesh) if self.mesh is not None \
+            else _nullcontext()
+
+    def _lowered(self):
+        """AOT-lower the step program (re-traces; mesh scope active so the
+        trace takes the same op routes as the live step)."""
+        with self._mesh_ctx():
+            return self._jitted.lower(*self._lower_args)
 
 
 class EvalStep:
@@ -321,6 +336,8 @@ class EvalStep:
             self._jitted = self._build(len(datas))
         key = _rng.next_key()
         param_datas = tuple(p.data()._data for p in self._params)
-        outs = self._jitted(param_datas, key, *datas)
+        with (mesh_scope(self.mesh) if self.mesh is not None
+              else _nullcontext()):
+            outs = self._jitted(param_datas, key, *datas)
         res = tuple(NDArray(o) for o in outs)
         return res[0] if len(res) == 1 else res

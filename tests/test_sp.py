@@ -113,7 +113,46 @@ def test_ring_rejects_dropout_in_training():
                     impl="ring", dropout_p=0.1)
 
 
-def _train_bert_steps(mesh, rules, n_steps=3):
+def test_auto_routes_to_ring_under_sp_mesh():
+    """impl='auto' must select the ring path when an sp axis is active —
+    SURVEY.md §5.7: sequence parallelism with no model-code changes."""
+    from mxnet_tpu.ops.nn import _ring_auto_ok
+    q, k, v = _qkv()
+    mesh = par.make_mesh(sp=4, devices=jax.devices()[:4])
+    with par.mesh_scope(mesh):
+        assert _ring_auto_ok(q, k, None, train_drop=False)
+        assert not _ring_auto_ok(q, k, None, train_drop=True)
+        out = dpa.raw_fn(q, k, v, impl="auto")
+    ref = _ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # T=30 not divisible by sp=4 → falls back, still correct
+    qo, ko, vo = (a[:, :, :30] for a in (q, k, v))
+    with par.mesh_scope(mesh):
+        assert not _ring_auto_ok(qo, ko, None, train_drop=False)
+        out = dpa.raw_fn(qo, ko, vo, impl="auto")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(qo, ko, vo)),
+                               rtol=2e-5, atol=2e-5)
+    # no mesh → no ring
+    assert not _ring_auto_ok(q, k, None, train_drop=False)
+
+
+def test_trainstep_sp_end_to_end():
+    """BERT TrainStep over a dp×sp mesh: impl='auto' puts the ppermute ring
+    in the compiled step, and the loss trajectory matches single-device."""
+    mesh = par.make_mesh(dp=2, sp=2, devices=jax.devices()[:4])
+    losses_sp, step = _train_bert_steps(
+        mesh, rules=None, seq_specs=True, return_step=True)
+    txt = step._lowered().as_text()
+    assert "collective_permute" in txt or "collective-permute" in txt, \
+        "sp mesh active but no ppermute ring in the compiled train step"
+    losses_single, _ = _train_bert_steps(None, rules=None, return_step=True)
+    np.testing.assert_allclose(losses_sp, losses_single, rtol=2e-4,
+                               atol=1e-5)
+
+
+def _train_bert_steps(mesh, rules, n_steps=3, seq_specs=False,
+                      return_step=False):
     """Tiny BERT trained for n_steps under the given mesh/rules; returns
     the loss trajectory (the fsdp==replicated equivalence oracle)."""
     from mxnet_tpu import optimizer as opt
@@ -132,9 +171,9 @@ def _train_bert_steps(mesh, rules, n_steps=3):
         par.apply_sharding_rules(net, rules)
     o = opt.AdamW(learning_rate=1e-3, wd=0.01)
     lfn = gloss.SoftmaxCrossEntropyLoss()
+    seq = P("dp", "sp") if seq_specs else P("dp")
     step = par.TrainStep(net, lfn, o, mesh=mesh, n_net_inputs=4,
-                         batch_specs=(P("dp"), P("dp"), P("dp"), P("dp"),
-                                      P("dp")))
+                         batch_specs=(seq, seq, P("dp"), P("dp"), P("dp")))
     batch, seq_len, n_masked = 4, 16, 4
     ids = mx.nd.array(rng.integers(0, 64, (batch, seq_len)), dtype="int32")
     tt = mx.nd.array(np.zeros((batch, seq_len)), dtype="int32")
@@ -144,8 +183,9 @@ def _train_bert_steps(mesh, rules, n_steps=3):
         dtype="int32")
     labels = mx.nd.array(rng.integers(0, 64, (batch, n_masked)),
                          dtype="int32")
-    return [float(step(ids, tt, vl, pos, labels).asscalar())
-            for _ in range(n_steps)]
+    losses = [float(step(ids, tt, vl, pos, labels).asscalar())
+              for _ in range(n_steps)]
+    return (losses, step) if return_step else losses
 
 
 def test_fsdp_matches_replicated():
