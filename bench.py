@@ -233,6 +233,53 @@ def bench_resnet50():
     return 0
 
 
+def bench_gpt2_decode():
+    """GPT-2 774M autoregressive decode tokens/sec (BASELINE.json target
+    workload 'GluonNLP GPT-2 774M'; SURVEY.md §3.5). Runs the static
+    paged-KV-cache while_loop decode — one compiled program for the whole
+    generation. No reference-side number exists (BASELINE.md row is
+    TBD-verify), so vs_baseline is 0.0 with the context in extras."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import GPT2ForCausalLM, gpt2_774m_config
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    batch = int(os.environ.get("BENCH_DECODE_BATCH", 8))
+    prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", 128))
+    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", 128))
+    cfg = gpt2_774m_config(dtype="bfloat16" if on_tpu else "float32",
+                           dropout=0.0, attention_dropout=0.0)
+    if not on_tpu:  # CPU smoke config
+        cfg.vocab_size, cfg.units, cfg.hidden_size = 512, 64, 256
+        cfg.num_layers, cfg.num_heads, cfg.max_length = 2, 2, 256
+        batch, prompt_len, new_tokens = 2, 16, 16
+
+    net = GPT2ForCausalLM(cfg)
+    net.initialize(mx.init.Normal(0.02))
+    if on_tpu:
+        net.cast("bfloat16")
+    rng = np.random.default_rng(0)
+    ids = mx.nd.array(rng.integers(0, cfg.vocab_size, (batch, prompt_len)),
+                      dtype="int32")
+    out = net.generate(ids, new_tokens, paged=True, page_size=64)
+    np.asarray(out.asnumpy())  # fetch = sync (compile + warmup)
+    t0 = time.perf_counter()
+    out = net.generate(ids, new_tokens, paged=True, page_size=64)
+    out.asnumpy()
+    dt = time.perf_counter() - t0
+    toks = batch * new_tokens / dt
+    _emit("gpt2_774m_decode_tokens_per_sec", round(toks, 1), "tokens/sec",
+          0.0, extras={
+              "batch": batch, "prompt_len": prompt_len,
+              "new_tokens": new_tokens, "params": cfg.num_params(),
+              "ms_per_token": round(dt / new_tokens * 1e3, 2),
+              "device": str(dev.device_kind), "kv_cache": "paged(64)",
+              "baseline": "none recorded (BASELINE.md GPT-2 row TBD)",
+          })
+    return 0
+
+
 def main():
     import jax
     # rbg (hardware RNG) for dropout masks: threefry mask generation costs
@@ -267,6 +314,8 @@ def main():
         return bench_bert()
     if workload in ("resnet", "resnet50", "resnet50_v1b"):
         return bench_resnet50()
+    if workload in ("gpt2", "gpt2_decode", "gpt2_774m"):
+        return bench_gpt2_decode()
     _emit("unknown_workload", 0.0, "none", 0.0, error=workload)
     return 1
 

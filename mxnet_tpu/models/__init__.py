@@ -13,4 +13,8 @@ def get_model(name, **kwargs):
 from .bert import (  # noqa: F401,E402
     BertConfig, BertForMaskedLM, BertForPretraining, BertModel,
     bert_base_config, bert_large_config)
+from .gpt2 import (  # noqa: F401,E402
+    GPT2Config, GPT2ForCausalLM, GPT2Model, gpt2_774m_config,
+    gpt2_medium_config, gpt2_small_config, gpt2_xl_config)
+from .kv_cache import KVCache, PagedKVCache  # noqa: F401,E402
 from . import vision  # noqa: F401,E402

@@ -1,0 +1,328 @@
+"""GPT-2 model family with static-cache autoregressive decode.
+
+Reference parity: GluonNLP's GPT-2 (gluon-nlp model zoo, text-generation
+scripts; target workload "GPT-2 774M" in BASELINE.json). SURVEY.md §3.5
+documents the reference's decode loop: hybridized step with per-layer
+(k, v) state lists re-`nd.concat`-ed every token — reallocation plus
+per-length shape re-inference. Here decode runs against the static
+KVCache/PagedKVCache primitive (models/kv_cache.py) inside ONE compiled
+`lax.while_loop` program (ops/control_flow.py), so the whole generation
+is a single XLA computation with no host round-trips and no
+recompilation per length.
+
+Attr names (query/key/value/proj, fc1/fc2, *_embed) line up with
+parallel.megatron_dense_rules so tp/fsdp sharding attaches unchanged.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..gluon.block import HybridBlock, _trace_channel
+from ..gluon.nn import Dense, Dropout, Embedding, LayerNorm
+from ..ndarray.ndarray import NDArray
+from ..ops import nn as _opnn
+from .kv_cache import KVCache, PagedKVCache
+
+__all__ = ["GPT2Config", "GPT2Model", "GPT2ForCausalLM", "gpt2_small_config",
+           "gpt2_medium_config", "gpt2_774m_config", "gpt2_xl_config"]
+
+
+class GPT2Config:
+    def __init__(self, vocab_size=50257, units=768, num_layers=12,
+                 num_heads=12, max_length=1024, dropout=0.1,
+                 attention_dropout=0.1, layer_norm_eps=1e-5,
+                 activation="gelu_tanh", attention_impl="auto",
+                 dtype="float32"):
+        self.vocab_size = vocab_size
+        self.units = units
+        self.hidden_size = 4 * units
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.max_length = max_length
+        self.dropout = dropout
+        self.attention_dropout = attention_dropout
+        self.layer_norm_eps = layer_norm_eps
+        self.activation = activation
+        self.attention_impl = attention_impl
+        self.dtype = dtype
+
+    def num_params(self):
+        c = self
+        embed = (c.vocab_size + c.max_length) * c.units
+        per_layer = (4 * (c.units * c.units + c.units)
+                     + 2 * c.units * c.hidden_size
+                     + c.hidden_size + c.units
+                     + 4 * c.units)
+        return embed + c.num_layers * per_layer + 2 * c.units  # final LN
+
+
+def gpt2_small_config(**kw):           # 124M
+    return GPT2Config(**kw)
+
+
+def gpt2_medium_config(**kw):          # 355M
+    kw.setdefault("units", 1024)
+    kw.setdefault("num_layers", 24)
+    kw.setdefault("num_heads", 16)
+    return GPT2Config(**kw)
+
+
+def gpt2_774m_config(**kw):            # the BASELINE.json target workload
+    kw.setdefault("units", 1280)
+    kw.setdefault("num_layers", 36)
+    kw.setdefault("num_heads", 20)
+    return GPT2Config(**kw)
+
+
+def gpt2_xl_config(**kw):              # 1.5B
+    kw.setdefault("units", 1600)
+    kw.setdefault("num_layers", 48)
+    kw.setdefault("num_heads", 25)
+    return GPT2Config(**kw)
+
+
+class GPT2Attention(HybridBlock):
+    """Causal self-attention with optional static-cache decode."""
+
+    def __init__(self, units, num_heads, dropout=0.0,
+                 attention_impl="auto", **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise MXNetError(f"units {units} % heads {num_heads} != 0")
+        self._units, self._num_heads = units, num_heads
+        self._dropout = dropout
+        self._impl = attention_impl
+        self.query = Dense(units, flatten=False, in_units=units)
+        self.key = Dense(units, flatten=False, in_units=units)
+        self.value = Dense(units, flatten=False, in_units=units)
+        self.proj = Dense(units, flatten=False, in_units=units)
+
+    def _split(self, x):
+        b, t, _ = x.shape
+        h, d = self._num_heads, self._units // self._num_heads
+        return x.reshape((b, t, h, d)).transpose((0, 2, 1, 3))
+
+    def forward(self, x, cache=None, layer_idx=None):
+        q = self._split(self.query(x))
+        k = self._split(self.key(x))
+        v = self._split(self.value(x))
+        if cache is None:
+            out = _opnn.dot_product_attention(
+                q, k, v, causal=True, dropout_p=self._dropout,
+                impl=self._impl)
+        else:
+            # static-cache path (inference): write this chunk at position
+            # cache.length, attend over the full buffer under a validity ×
+            # causal mask. The chunk is either the whole prompt (prefill)
+            # or one token (decode).
+            t = q.shape[2]
+            if t > 1:
+                k_all, v_all, cache = cache.write_prompt(
+                    layer_idx, k._data, v._data)
+            else:
+                k_all, v_all, cache = cache.write(
+                    layer_idx, k._data, v._data)
+            valid = cache.key_mask(extra=t)           # (T_max,)
+            q_pos = cache.length + jnp.arange(t)      # global positions
+            k_pos = jnp.arange(k_all.shape[2])
+            causal = k_pos[None, :] <= q_pos[:, None]  # (t, T_max)
+            mask = (valid[None, :] & causal)[None, None]  # (1,1,t,T_max)
+            out = _opnn.dot_product_attention(
+                q, NDArray(k_all.astype(q._data.dtype)),
+                NDArray(v_all.astype(q._data.dtype)), NDArray(mask),
+                impl="xla" if self._impl == "ring" else self._impl)
+        b, h, t, d = out.shape
+        out = out.transpose((0, 2, 1, 3)).reshape((b, t, h * d))
+        return self.proj(out), cache
+
+
+class GPT2Block(HybridBlock):
+    """Pre-LN transformer block (GPT-2 style)."""
+
+    def __init__(self, cfg: GPT2Config, **kwargs):
+        super().__init__(**kwargs)
+        c = cfg
+        self.ln1 = LayerNorm(epsilon=c.layer_norm_eps, in_channels=c.units)
+        self.attn = GPT2Attention(c.units, c.num_heads,
+                                  dropout=c.attention_dropout,
+                                  attention_impl=c.attention_impl)
+        self.ln2 = LayerNorm(epsilon=c.layer_norm_eps, in_channels=c.units)
+        self.fc1 = Dense(c.hidden_size, flatten=False, in_units=c.units)
+        self.fc2 = Dense(c.units, flatten=False, in_units=c.hidden_size)
+        self._activation = c.activation
+        self.dropout = Dropout(c.dropout) if c.dropout else None
+
+    def forward(self, x, cache=None, layer_idx=None):
+        h, cache = self.attn(self.ln1(x), cache, layer_idx)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        x = x + h
+        h = _opnn.Activation(self.fc1(self.ln2(x)),
+                             act_type=self._activation)
+        h = self.fc2(h)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        return x + h, cache
+
+
+class GPT2Model(HybridBlock):
+    """Embeddings + pre-LN blocks + final LN."""
+
+    def __init__(self, config: GPT2Config, **kwargs):
+        super().__init__(**kwargs)
+        c = self.config = config
+        self.word_embed = Embedding(c.vocab_size, c.units, dtype=c.dtype)
+        self.position_embed = Embedding(c.max_length, c.units, dtype=c.dtype)
+        self.embed_dropout = Dropout(c.dropout) if c.dropout else None
+        for i in range(c.num_layers):
+            self.register_child(GPT2Block(c), name=f"layer{i}")
+        self.ln_f = LayerNorm(epsilon=c.layer_norm_eps, in_channels=c.units)
+
+    def blocks(self):
+        return [child for name, child in self._children.items()
+                if name.startswith("layer")]
+
+    def forward(self, inputs, cache=None):
+        b, t = inputs.shape
+        start = cache.length if cache is not None else 0
+        positions = NDArray(start + jnp.arange(t, dtype=jnp.int32))
+        x = self.word_embed(inputs) + self.position_embed(positions)
+        if self.embed_dropout is not None:
+            x = self.embed_dropout(x)
+        for i, block in enumerate(self.blocks()):
+            x, cache = block(x, cache, i)
+        x = self.ln_f(x)
+        if cache is not None:
+            cache = cache.advance(t)
+        return x, cache
+
+
+class GPT2ForCausalLM(HybridBlock):
+    """GPT-2 with the weight-tied LM head + static-cache generate()."""
+
+    def __init__(self, config: GPT2Config, **kwargs):
+        super().__init__(**kwargs)
+        self.config = config
+        self.backbone = GPT2Model(config)
+
+    def forward(self, inputs, cache=None):
+        h, cache = self.backbone(inputs, cache)
+        w = self.backbone.word_embed.weight.data()   # (V, C) tied
+        logits = _opnn.FullyConnected(h, w, None, no_bias=True,
+                                      flatten=False)
+        if cache is None:
+            return logits
+        return logits, cache
+
+    # -- decode -----------------------------------------------------------
+    def make_cache(self, batch, max_length, paged=False, page_size=64,
+                   dtype=None, page_table=None):
+        c = self.config
+        cls = PagedKVCache if paged else KVCache
+        kw = dict(page_size=page_size, page_table=page_table) if paged \
+            else {}
+        return cls.create(c.num_layers, batch, c.num_heads, max_length,
+                          c.units // c.num_heads,
+                          dtype=dtype or jnp.dtype(c.dtype), **kw)
+
+    def generate(self, input_ids, max_new_tokens, do_sample=False,
+                 temperature=1.0, top_k=None, eos_token_id=None, seed=0,
+                 paged=False, page_size=64):
+        """Autoregressive generation: prefill + ONE compiled while_loop
+        decode over the static cache (greedy, or top-k/temperature
+        sampling). Returns (B, max_new_tokens) int32 NDArray; positions
+        after an eos_token_id hit are padded with eos.
+
+        This is the SURVEY §3.5 fix: the reference re-concats KV state and
+        re-infers shapes per token; here token t+1 costs exactly one
+        cached-program execution."""
+        from ..ops.control_flow import while_loop
+
+        ids = input_ids._data if isinstance(input_ids, NDArray) \
+            else jnp.asarray(input_ids)
+        ids = ids.astype(jnp.int32)
+        B, T0 = ids.shape
+        total = T0 + max_new_tokens
+        c = self.config
+        if total > c.max_length:
+            raise MXNetError(
+                f"prompt {T0} + {max_new_tokens} new > max_length "
+                f"{c.max_length}")
+        if paged:
+            total = ((total + page_size - 1) // page_size) * page_size
+        params = list(self.collect_params().values())
+        param_datas = tuple(p.data()._data for p in params)
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+
+        def _select(logits, key, step):
+            logits = logits.astype(jnp.float32)
+            if not do_sample:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if temperature != 1.0:
+                logits = logits / temperature
+            if top_k is not None:
+                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            k = jax.random.fold_in(key, step)
+            return jax.random.categorical(k, logits, axis=-1).astype(
+                jnp.int32)
+
+        def run(param_arrays, prompt, key):
+            saved = [p._data for p in params]
+            _trace_channel.push_frame()
+            try:
+                for p, d in zip(params, param_arrays):
+                    arr = NDArray(d)
+                    arr._grad_req = "null"
+                    p._data = arr
+                cache = self.make_cache(B, total, paged=paged,
+                                        page_size=page_size)
+                logits, cache = self.forward(NDArray(prompt), cache)
+                next_tok = _select(logits._data[:, -1, :], key, 0)
+                raw = lambda x: x._data if isinstance(x, NDArray) else x  # noqa: E731
+
+                def cond_fn(i, tok, cache, out, done):
+                    i, done = raw(i), raw(done)
+                    return (i < max_new_tokens) & ~done.all()
+
+                def body_fn(i, tok, cache, out, done):
+                    i, tok, out, done = map(raw, (i, tok, out, done))
+                    # the eos token itself is emitted; rows already done
+                    # keep padding with eos
+                    out = out.at[:, i].set(jnp.where(done, eos, tok))
+                    logits, cache2 = self.forward(
+                        NDArray(tok[:, None]), cache)
+                    nxt = _select(logits._data[:, -1, :], key, i + 1)
+                    done = done | (tok == eos)
+                    return (), (i + 1, nxt, cache2, out, done)
+
+                # body writes slot i each iteration (0..max_new-1); on an
+                # all-eos early exit the untouched tail keeps the eos fill
+                out0 = jnp.full((B, max_new_tokens),
+                                eos if eos_token_id is not None else 0,
+                                jnp.int32)
+                done0 = jnp.zeros((B,), bool)
+                _, final = while_loop(
+                    cond_fn, body_fn,
+                    [jnp.zeros((), jnp.int32), next_tok, cache, out0,
+                     done0],
+                    max_iterations=max_new_tokens)
+                return raw(final[3])
+            finally:
+                _trace_channel.pop_frame()
+                for p, d in zip(params, saved):
+                    p._data = d
+
+        key = jax.random.PRNGKey(seed)
+        jitted = self.__dict__.setdefault("_generate_cache", {})
+        sig = (B, T0, max_new_tokens, do_sample, temperature, top_k,
+               eos_token_id, paged, page_size)
+        fn = jitted.get(sig)
+        if fn is None:
+            fn = jax.jit(run)
+            jitted[sig] = fn
+        return NDArray(fn(param_datas, ids, key))

@@ -1,0 +1,186 @@
+"""KV caches for autoregressive decode — a first-class primitive.
+
+Reference parity: NONE, by design. SURVEY.md §3.5 documents the
+reference's decode wart: GluonNLP models thread per-layer (k, v) NDArrays
+and `nd.concat(prev_k, new_k, dim=time)` every step — reallocating the
+whole cache and forcing CachedOp shape re-inference per length. The brief
+calls the static-shape replacement out as the one primitive the rebuild
+must provide. Two variants, both functional pytrees (carried through
+`lax.while_loop` decode bodies, updated in place by XLA via buffer
+donation):
+
+  * KVCache — contiguous per-layer (B, H, T_max, D) buffers written with
+    `lax.dynamic_update_slice`. The fast path for fixed-batch decode.
+  * PagedKVCache — a static PAGE POOL (L, num_pages, page_size, H, D)
+    plus a per-sequence page table (B, pages_per_seq). Attention gathers
+    pages through the table, so sequences own arbitrary page sets —
+    the serving-style layout (cf. ragged paged attention, PAPERS.md)
+    with O(1) append and no per-length recompilation.
+
+Both share the same API so models are cache-agnostic:
+    write(layer, k_new, v_new)  -> (k_all, v_all, new_cache)
+    write_prompt(layer, k, v)   -> (k_all, v_all, new_cache)  # prefill
+    advance(n)                  -> new_cache  # once per model forward
+    key_mask(extra)             -> (T_view,) bool validity over k_all
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+
+__all__ = ["KVCache", "PagedKVCache"]
+
+
+@jax.tree_util.register_pytree_node_class
+class KVCache:
+    """Contiguous static cache: k/v of shape (L, B, H, T_max, D)."""
+
+    def __init__(self, k, v, length):
+        self.k = k
+        self.v = v
+        self.length = length  # scalar int32: tokens written so far
+
+    @classmethod
+    def create(cls, num_layers, batch, num_heads, max_length, head_dim,
+               dtype=jnp.float32):
+        shape = (num_layers, batch, num_heads, max_length, head_dim)
+        return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                   jnp.zeros((), jnp.int32))
+
+    @property
+    def max_length(self):
+        return self.k.shape[3]
+
+    def write(self, layer, k_new, v_new):
+        """Write one step: k_new/v_new (B, H, t, D) at offset `length`.
+        Returns the FULL (B, H, T_max, D) views + the updated cache."""
+        start = (0, 0, self.length, 0)
+        k_l = lax.dynamic_update_slice(self.k[layer],
+                                       k_new.astype(self.k.dtype), start)
+        v_l = lax.dynamic_update_slice(self.v[layer],
+                                       v_new.astype(self.v.dtype), start)
+        new = KVCache(self.k.at[layer].set(k_l), self.v.at[layer].set(v_l),
+                      self.length)
+        return k_l, v_l, new
+
+    # prefill is the same dynamic-slice write (t = prompt length)
+    write_prompt = write
+
+    def advance(self, n):
+        return KVCache(self.k, self.v, self.length + n)
+
+    def key_mask(self, extra=0):
+        """(T_max,) bool: True for written positions (+ `extra` being
+        written this step)."""
+        return jnp.arange(self.max_length) < (self.length + extra)
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.length), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+@jax.tree_util.register_pytree_node_class
+class PagedKVCache:
+    """Page-pool cache: k/v pools (L, num_pages, page_size, H, D) indexed
+    through a per-sequence page_table (B, pages_per_seq)."""
+
+    def __init__(self, k_pages, v_pages, page_table, length):
+        self.k_pages = k_pages
+        self.v_pages = v_pages
+        self.page_table = page_table
+        self.length = length
+
+    @classmethod
+    def create(cls, num_layers, batch, num_heads, max_length, head_dim,
+               dtype=jnp.float32, page_size=64, num_pages=None,
+               page_table=None):
+        if max_length % page_size:
+            raise MXNetError(
+                f"max_length {max_length} not a multiple of page_size "
+                f"{page_size}")
+        per_seq = max_length // page_size
+        if num_pages is None:
+            num_pages = batch * per_seq
+        if page_table is None:
+            # default allocation: sequence b owns pages [b*P, (b+1)*P) —
+            # any permutation works (attention always goes through the
+            # table; tests permute it to prove real paging)
+            page_table = jnp.arange(batch * per_seq, dtype=jnp.int32
+                                    ).reshape(batch, per_seq)
+            if num_pages < batch * per_seq:
+                raise MXNetError(
+                    f"{num_pages} pages < {batch}x{per_seq} required")
+        shape = (num_layers, num_pages, page_size, num_heads, head_dim)
+        return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                   jnp.asarray(page_table, jnp.int32),
+                   jnp.zeros((), jnp.int32))
+
+    @property
+    def page_size(self):
+        return self.k_pages.shape[2]
+
+    @property
+    def max_length(self):
+        return self.page_table.shape[1] * self.page_size
+
+    def _gather(self, pages, layer):
+        # (num_pages, page_size, H, D)[table (B, P)] → (B, T, H, D) → BHTD
+        g = jnp.take(pages[layer], self.page_table, axis=0)
+        B, P, S, H, D = g.shape
+        return g.reshape(B, P * S, H, D).transpose(0, 2, 1, 3)
+
+    def write(self, layer, k_new, v_new):
+        """Decode write: k_new/v_new (B, H, 1, D) appended at `length`.
+        Returns full gathered (B, H, T_max, D) views + updated cache."""
+        page_idx = self.length // self.page_size
+        slot = self.length % self.page_size
+        pages = self.page_table[:, page_idx]          # (B,) physical page
+        # pool slot layout is (page, slot, H, D) → one (B, H, D) slab
+        k_t = k_new[:, :, 0, :]
+        v_t = v_new[:, :, 0, :]
+        kp = self.k_pages.at[layer, pages, slot].set(
+            k_t.astype(self.k_pages.dtype))
+        vp = self.v_pages.at[layer, pages, slot].set(
+            v_t.astype(self.v_pages.dtype))
+        new = PagedKVCache(kp, vp, self.page_table, self.length)
+        return new._gather(kp, layer), new._gather(vp, layer), new
+
+    def write_prompt(self, layer, k, v):
+        """Prefill write of a whole (B, H, T, D) prompt starting at
+        position 0 (requires length==0 at call time; T is padded up to
+        whole pages)."""
+        B, H, T, D = k.shape
+        S = self.page_size
+        n_pages = (T + S - 1) // S
+        pad = n_pages * S - T
+        kq = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vq = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        # (B, H, nP*S, D) → (B, nP, S, H, D) — the pool's page layout
+        kq = kq.transpose(0, 2, 1, 3).reshape(B, n_pages, S, H, D)
+        vq = vq.transpose(0, 2, 1, 3).reshape(B, n_pages, S, H, D)
+        tbl = self.page_table[:, :n_pages]            # (B, nP)
+        kp = self.k_pages.at[layer, tbl].set(kq.astype(self.k_pages.dtype))
+        vp = self.v_pages.at[layer, tbl].set(vq.astype(self.v_pages.dtype))
+        new = PagedKVCache(kp, vp, self.page_table, self.length)
+        return new._gather(kp, layer), new._gather(vp, layer), new
+
+    def advance(self, n):
+        return PagedKVCache(self.k_pages, self.v_pages, self.page_table,
+                            self.length + n)
+
+    def key_mask(self, extra=0):
+        return jnp.arange(self.max_length) < (self.length + extra)
+
+    def tree_flatten(self):
+        return (self.k_pages, self.v_pages, self.page_table,
+                self.length), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)

@@ -13,6 +13,7 @@ from ..ops.init import (  # noqa: F401
 )
 from ..ops import math, tensor, nn, init  # noqa: F401
 from ..ops import random  # noqa: F401
+from . import contrib  # noqa: F401
 from ..ops.registry import OPS
 
 

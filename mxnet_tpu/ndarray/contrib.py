@@ -1,0 +1,6 @@
+"""mx.nd.contrib namespace.
+
+Reference parity: python/mxnet/ndarray/contrib.py — the python wrappers
+over src/operator/control_flow.cc's foreach/while_loop/cond.
+"""
+from ..ops.control_flow import cond, foreach, while_loop  # noqa: F401

@@ -1,0 +1,179 @@
+"""Control-flow ops: foreach / while_loop / cond.
+
+Reference parity: src/operator/control_flow.cc — `foreach`, `while_loop`,
+`cond` (subgraph-carrying higher-order ops registered over nnvm subgraphs,
+with python wrappers in ndarray/contrib.py and symbol/contrib.py). The
+reference executes the captured subgraph once per iteration through the
+engine; here the body traces ONCE and lowers to the native XLA control-flow
+constructs — `lax.scan` / `lax.while_loop` / `lax.cond` — so a decode loop
+or an unrolled RNN is a single compiled program with static shapes
+(SURVEY.md §2.3 'Control flow', §7.3.2).
+
+Semantics notes (vs the reference):
+  * Bodies are Python callables over NDArrays. Data/state/loop-var inputs
+    are differentiable tape inputs in eager autograd; parameters captured
+    by closure participate in gradients on the hybridize()/TrainStep path
+    (where the whole program is one jax trace), matching where the
+    reference expects training to run.
+  * `while_loop` is static-shape: outputs are buffers of length
+    `max_iterations` (the reference's symbolic mode requires
+    max_iterations for the same reason). Called eagerly, outputs are
+    trimmed to the realized step count, matching the reference's
+    imperative mode; inside a trace they stay padded (zeros beyond the
+    realized steps) and the realized count is returned as `num_steps`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _nd():
+    from ..ndarray.ndarray import NDArray
+    return NDArray
+
+
+def _unwrap(x):
+    """NDArray(-tree) → jax(-tree)."""
+    NDArray = _nd()
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return x
+
+
+def _wrap(x):
+    """jax(-tree) → NDArray(-tree)."""
+    NDArray = _nd()
+    if isinstance(x, jax.Array) or hasattr(x, "aval"):
+        return NDArray(x)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_wrap(v) for v in x)
+    return x
+
+
+def _is_concrete(x):
+    return isinstance(x, jax.Array) and not isinstance(
+        x, jax.core.Tracer)
+
+
+def _as_nd(x):
+    NDArray = _nd()
+    return x if isinstance(x, NDArray) else NDArray(x)
+
+
+def foreach(body, data, init_states):
+    """Run `body` over axis-0 slices of `data`, threading states.
+
+    body(data_slice, states) -> (outputs, new_states). data may be an
+    NDArray or a list of NDArrays (sliced in lockstep); states/outputs may
+    be NDArrays or (possibly empty) lists. Returns (outputs, final_states)
+    with per-step outputs stacked along a new axis 0 — exactly the
+    reference's mx.nd.contrib.foreach contract, lowered to lax.scan.
+
+    Autograd: data/init_states are differentiable tape inputs (one tape
+    node for the whole scan, like the reference's subgraph op); parameters
+    the body captures by closure get gradients on the hybridize()/
+    TrainStep path where the entire program is one trace.
+    """
+    from .registry import apply_op
+    from .. import autograd as _ag
+
+    leaves, tree = jax.tree_util.tree_flatten((data, init_states))
+    struct = {}
+
+    def closed(*datas):
+        data_j, states_j = jax.tree_util.tree_unflatten(tree, datas)
+
+        def step(carry, x):
+            with _ag.pause(train_mode=_ag.is_training()):
+                out, new_states = body(_wrap(x), _wrap(carry))
+            return _unwrap(new_states), _unwrap(out)
+
+        final, ys = lax.scan(step, _unwrap(states_j), _unwrap(data_j))
+        out_leaves, out_tree = jax.tree_util.tree_flatten((ys, final))
+        struct["tree"] = out_tree
+        return tuple(out_leaves)
+
+    outs = apply_op("foreach", closed, [_as_nd(l) for l in leaves])
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    ys, final = jax.tree_util.tree_unflatten(struct["tree"], list(outs))
+    return ys, final
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Iterate func while cond holds, up to max_iterations.
+
+    cond(*loop_vars) -> boolean scalar; func(*loop_vars) ->
+    (step_output, new_loop_vars). Returns (outputs, final_loop_vars):
+    outputs are the per-step step_outputs stacked along axis 0 in buffers
+    of length max_iterations (trimmed to the realized count when called
+    eagerly; see module docstring). Parity: mx.nd.contrib.while_loop,
+    lowered to ONE lax.while_loop with preallocated output buffers.
+
+    Not differentiable (XLA's While has no reverse-mode); it is the
+    inference/decode construct — use foreach (scan) in training graphs.
+    """
+    from .. import autograd as _ag
+
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations (static-shape "
+                         "TPU contract, matching the reference's symbolic "
+                         "mode)")
+    max_iterations = int(max_iterations)
+    loop_j = tuple(_unwrap(v) for v in loop_vars)
+
+    # trace one step eagerly-abstractly to learn the output structure
+    with _ag.pause(train_mode=_ag.is_training()):
+        out_shapes = jax.eval_shape(
+            lambda lv: _unwrap(func(*_wrap(lv))[0]), loop_j)
+    out_leaves, out_tree = jax.tree_util.tree_flatten(out_shapes)
+    buffers = tuple(jnp.zeros((max_iterations,) + tuple(l.shape), l.dtype)
+                    for l in out_leaves)
+
+    def cond_fn(carry):
+        i, lv, _ = carry
+        with _ag.pause(train_mode=_ag.is_training()):
+            c = _unwrap(cond(*_wrap(lv)))
+        return jnp.logical_and(i < max_iterations,
+                               jnp.reshape(jnp.asarray(c), ()))
+
+    def body_fn(carry):
+        i, lv, bufs = carry
+        with _ag.pause(train_mode=_ag.is_training()):
+            out, new_lv = func(*_wrap(lv))
+        leaves = jax.tree_util.tree_leaves(_unwrap(out))
+        bufs = tuple(
+            lax.dynamic_update_index_in_dim(b, jnp.asarray(l, b.dtype), i, 0)
+            for b, l in zip(bufs, leaves))
+        return i + 1, tuple(_unwrap(v) for v in new_lv), bufs
+
+    n, final_lv, bufs = lax.while_loop(
+        cond_fn, body_fn, (jnp.zeros((), jnp.int32), loop_j, buffers))
+    if _is_concrete(n):  # eager: trim to realized steps (reference parity)
+        k = int(n)
+        bufs = tuple(b[:k] for b in bufs)
+    outputs = jax.tree_util.tree_unflatten(out_tree, list(bufs))
+    return _wrap(outputs), [_wrap(v) for v in final_lv]
+
+
+def cond(pred, then_func, else_func):
+    """Run then_func() if pred else else_func() (parity:
+    mx.nd.contrib.cond → lax.cond). Both branches must return the same
+    structure of arrays with matching shapes/dtypes."""
+    p = _unwrap(pred)
+    if not _is_concrete(jnp.asarray(p) if not hasattr(p, "aval") else p):
+        # inside an enclosing trace: lower to lax.cond
+        return _wrap(lax.cond(jnp.reshape(p, ()),
+                              lambda: _unwrap(then_func()),
+                              lambda: _unwrap(else_func())))
+    # eager: run only the taken branch (reference imperative semantics —
+    # and its ops tape normally, so gradients flow)
+    return then_func() if bool(jnp.reshape(p, ())) else else_func()

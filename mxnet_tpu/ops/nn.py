@@ -76,6 +76,10 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     rhs_spec = "OI" + spatial
     dn = lax.conv_dimension_numbers(data.shape, weight.shape,
                                     (lhs_spec, rhs_spec, lhs_spec))
+    # NOTE: no preferred_element_type here — XLA:TPU accumulates bf16 convs
+    # in f32 on the MXU regardless, and this jax version's conv transpose
+    # rule rejects mixed primal/cotangent dtypes when it is set (bf16
+    # training would crash in backward)
     y = lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
@@ -83,10 +87,7 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
     )
-    if y.dtype != data.dtype:
-        y = y.astype(data.dtype)
     if bias is not None and not no_bias:
         y = y + jnp.reshape(bias, (1, -1) + (1,) * nd)
     return y

@@ -1,0 +1,111 @@
+"""Control-flow ops (parity: tests/python/unittest/
+test_contrib_control_flow.py — foreach/while_loop/cond semantics)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def test_foreach_cumsum():
+    data = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    init = mx.nd.zeros((3,))
+
+    def body(x, state):
+        new = state + x
+        return new, new
+
+    outs, final = mx.nd.contrib.foreach(body, data, init)
+    np.testing.assert_allclose(outs.asnumpy(),
+                               np.cumsum(data.asnumpy(), axis=0))
+    np.testing.assert_allclose(final.asnumpy(),
+                               data.asnumpy().sum(axis=0))
+
+
+def test_foreach_multiple_states_and_outputs():
+    data = mx.nd.array(np.ones((5, 2), np.float32))
+
+    def body(x, states):
+        s, c = states
+        return [s + x, c * 2.0], [s + x, c * 2.0]
+
+    outs, final = mx.nd.contrib.foreach(
+        body, data, [mx.nd.zeros((2,)), mx.nd.ones((1,))])
+    assert outs[0].shape == (5, 2) and outs[1].shape == (5, 1)
+    np.testing.assert_allclose(final[0].asnumpy(), [5.0, 5.0])
+    np.testing.assert_allclose(final[1].asnumpy(), [32.0])
+
+
+def test_foreach_rnn_style_gradient_under_hybrid_trace():
+    """foreach inside a hybridized block: grads flow through lax.scan."""
+    from mxnet_tpu.gluon import HybridBlock, nn
+
+    class Cum(HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.proj = nn.Dense(3, in_units=3, use_bias=False)
+
+        def forward(self, x):
+            def body(xt, s):
+                s = s + self.proj(xt)
+                return s, s
+            outs, final = mx.nd.contrib.foreach(
+                body, x, mx.nd.zeros((x.shape[1], 3)))
+            return final.sum()
+
+    net = Cum()
+    net.initialize()
+    x = mx.nd.array(np.random.default_rng(0).random((4, 2, 3)),
+                    dtype="float32")
+    x.attach_grad()
+    with mx.autograd.record():
+        y = net(x)
+    y.backward()
+    w = net.proj.weight.data().asnumpy()
+    # d final / d x[t] = W^T summed over output dims → column sums of W
+    expect = np.broadcast_to(w.sum(axis=0), (4, 2, 3))
+    np.testing.assert_allclose(x.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_while_loop_eager_trims():
+    def cond(i, s):
+        return i < 5
+
+    def func(i, s):
+        return s + i, [i + 1, s + i]
+
+    outs, final = mx.nd.contrib.while_loop(
+        cond, func, [mx.nd.zeros(()), mx.nd.zeros(())], max_iterations=10)
+    # eager mode trims to the realized 5 steps (reference imperative mode)
+    assert outs.shape == (5,)
+    np.testing.assert_allclose(outs.asnumpy(), [0, 1, 3, 6, 10])
+    np.testing.assert_allclose(float(final[1].asscalar()), 10.0)
+
+
+def test_while_loop_max_iterations_required():
+    with pytest.raises(MXNetError, match="max_iterations"):
+        mx.nd.contrib.while_loop(lambda i: i < 3, lambda i: (i, [i]),
+                                 [mx.nd.zeros(())])
+
+
+def test_while_loop_hits_max():
+    outs, final = mx.nd.contrib.while_loop(
+        lambda i: i < 100, lambda i: (i * 2, [i + 1]),
+        [mx.nd.zeros(())], max_iterations=4)
+    assert outs.shape == (4,)
+    np.testing.assert_allclose(outs.asnumpy(), [0, 2, 4, 6])
+
+
+def test_cond_eager_and_traced():
+    x = mx.nd.array([2.0])
+    out = mx.nd.contrib.cond(x.sum() > 1.0, lambda: x * 10.0,
+                             lambda: x - 1.0)
+    np.testing.assert_allclose(out.asnumpy(), [20.0])
+
+    from mxnet_tpu import functional
+    f = functional.jit(lambda a: mx.nd.contrib.cond(
+        a.sum() > 1.0, lambda: a * 10.0, lambda: a - 1.0))
+    np.testing.assert_allclose(f(mx.nd.array([0.2])).asnumpy(), [-0.8],
+                               rtol=1e-6)
+    np.testing.assert_allclose(f(mx.nd.array([2.0])).asnumpy(), [20.0],
+                               rtol=1e-6)
