@@ -1,0 +1,81 @@
+"""Mixture-of-Experts FFN layer (expert-parallel over the "ep" mesh axis).
+
+Reference parity: none — SURVEY.md §2.4 records EP as absent from the
+reference; first-class here per the brief. The math lives in
+parallel/moe.py (GShard/Switch capacity-bounded dispatch); this layer
+owns the parameters: a gate Dense plus expert weights STACKED along a
+leading (E, ...) axis so `ep_rules()` shards dim 0 over "ep" and XLA
+partitions the expert einsums + inserts the dispatch/combine collectives.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...base import MXNetError
+from ...ops import nn as _opnn
+from ...ops.registry import apply_op
+from ..block import HybridBlock
+from ..parameter import Parameter
+from .basic_layers import Dense
+
+__all__ = ["MoEFFN"]
+
+_ACTS = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": jax.nn.silu,
+         "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True)}
+
+
+class MoEFFN(HybridBlock):
+    """Drop-in replacement for a transformer PositionwiseFFN: (B, T, C) →
+    (B, T, C) through num_experts expert FFNs with top-k routing.
+
+    forward(x, return_aux=True) returns (y, aux_loss); training code adds
+    aux_loss * weight into its objective (the Switch recipe).
+    """
+
+    def __init__(self, units, hidden_size, num_experts, top_k=2,
+                 capacity_factor=1.25, activation="gelu", **kwargs):
+        super().__init__(**kwargs)
+        if top_k > num_experts:
+            raise MXNetError(f"top_k {top_k} > num_experts {num_experts}")
+        if activation not in _ACTS:
+            raise MXNetError(f"unsupported MoE activation {activation!r}")
+        self._units = units
+        self._hidden = hidden_size
+        self._E = num_experts
+        self._top_k = top_k
+        self._cf = capacity_factor
+        self._activation = activation
+        self.gate = Dense(num_experts, flatten=False, use_bias=False,
+                          in_units=units)
+        self.expert_w1 = Parameter("expert_w1",
+                                   shape=(num_experts, units, hidden_size))
+        self.expert_b1 = Parameter("expert_b1",
+                                   shape=(num_experts, hidden_size),
+                                   init="zeros")
+        self.expert_w2 = Parameter("expert_w2",
+                                   shape=(num_experts, hidden_size, units))
+        self.expert_b2 = Parameter("expert_b2",
+                                   shape=(num_experts, units), init="zeros")
+
+    def forward(self, x, return_aux=False):
+        from ...parallel.moe import moe_dispatch_combine
+
+        b, t, c = x.shape
+        logits = self.gate(x)
+        act = _ACTS[self._activation]
+        top_k, cf = self._top_k, self._cf
+
+        def closed(xd, ld, w1, b1, w2, b2):
+            y, aux = moe_dispatch_combine(
+                xd.reshape(b * t, c), ld.reshape(b * t, self._E),
+                w1, b1, w2, b2, top_k=top_k, capacity_factor=cf,
+                activation=act)
+            return y.reshape(b, t, c), aux
+
+        y, aux = apply_op(
+            "MoEFFN", closed,
+            [x, logits, self.expert_w1.data(), self.expert_b1.data(),
+             self.expert_w2.data(), self.expert_b2.data()])
+        if return_aux:
+            return y, aux
+        return y

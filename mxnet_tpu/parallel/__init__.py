@@ -9,6 +9,10 @@ from .mesh import (  # noqa: F401
     NamedSharding, PartitionSpec, current_mesh, make_mesh, mesh_scope,
     named_sharding, set_default_mesh)
 from .rules import (  # noqa: F401
-    ShardingRules, apply_sharding_rules, fsdp_rules, megatron_dense_rules)
+    ShardingRules, apply_sharding_rules, ep_rules, fsdp_rules,
+    megatron_dense_rules)
 from .sp import ring_attention, sp_enabled  # noqa: F401
+from .pp import gpipe, stack_stage_params  # noqa: F401
+from .moe import (  # noqa: F401
+    all_to_all_tokens, moe_dispatch_combine, top_k_gating)
 from .step import EvalStep, TrainStep  # noqa: F401

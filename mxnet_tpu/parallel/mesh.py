@@ -29,7 +29,23 @@ from ..base import MXNetError
 
 __all__ = ["Mesh", "PartitionSpec", "NamedSharding", "make_mesh",
            "current_mesh", "mesh_scope", "set_default_mesh", "named_sharding",
+           "shard_map_compat",
            "AXIS_DP", "AXIS_TP", "AXIS_PP", "AXIS_SP", "AXIS_EP", "AXIS_FSDP"]
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs, check_rep=False):
+    """jax.shard_map across jax versions: 0.8+ renamed check_rep →
+    check_vma (and moved the function out of jax.experimental)."""
+    try:
+        from jax import shard_map as _sm
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map as _sm
+    try:
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=check_rep)
+    except TypeError:  # pragma: no cover - pre-0.8 signature
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_rep)
 
 AXIS_DP, AXIS_FSDP, AXIS_TP = "dp", "fsdp", "tp"
 AXIS_SP, AXIS_PP, AXIS_EP = "sp", "pp", "ep"

@@ -1,0 +1,114 @@
+"""Mixture-of-Experts FFN with expert parallelism over the "ep" mesh axis.
+
+Reference parity: none — the reference has no MoE (SURVEY.md §2.4
+presence matrix: EP absent); the brief makes it first-class here.
+
+TPU-native design (GShard/Switch formulation): top-k gating with a
+capacity-bounded one-hot dispatch, so every shape is static —
+
+    dispatch:  (S, E, Cap) one-hot   tokens → expert slots
+    compute:   (E, Cap, C) einsums over the stacked expert weights
+    combine:   gate-weighted inverse of dispatch
+
+Expert parallelism is a SHARDING of the stacked expert weights and the
+(E, Cap, C) activations over "ep" (PartitionSpec("ep", ...)): under
+pjit/TrainStep XLA partitions the expert einsums across devices and
+inserts the dispatch/combine all-to-all collectives the math requires —
+the idiomatic-TPU equivalent of hand-written NCCL all-to-all. An explicit
+`shard_map` + `lax.all_to_all` dispatch (`all_to_all_tokens`) is provided
+for token-sharded layouts.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .mesh import AXIS_EP, PartitionSpec, current_mesh, shard_map_compat
+
+__all__ = ["top_k_gating", "moe_dispatch_combine", "all_to_all_tokens"]
+
+
+def top_k_gating(logits, top_k, capacity):
+    """GShard-style gating. logits: (S, E). Returns
+    (dispatch (S, E, Cap) bool, combine (S, E, Cap) float32, aux_loss).
+
+    aux_loss is the Switch/GShard load-balancing loss: E * sum_e
+    mean(router_prob_e) * mean(tokens_routed_e)."""
+    S, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = lax.top_k(probs, top_k)          # (S, k)
+    # renormalize the kept gates (standard top-k MoE)
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    dispatch = jnp.zeros((S, E, capacity), bool)
+    combine = jnp.zeros((S, E, capacity), jnp.float32)
+    # running per-expert fill count decides each token's slot; tokens over
+    # capacity are DROPPED (their combine weight is 0) — the documented
+    # Switch behavior that keeps shapes static
+    fill = jnp.zeros((E,), jnp.int32)
+    for j in range(top_k):
+        e_j = gate_idx[:, j]                               # (S,)
+        onehot = jax.nn.one_hot(e_j, E, dtype=jnp.int32)   # (S, E)
+        pos = fill[e_j] + jnp.cumsum(onehot, axis=0)[
+            jnp.arange(S), e_j] - 1                        # slot per token
+        keep = pos < capacity
+        disp_j = (jax.nn.one_hot(e_j, E, dtype=bool)[:, :, None]
+                  & jax.nn.one_hot(jnp.where(keep, pos, 0), capacity,
+                                   dtype=bool)[:, None, :]
+                  & keep[:, None, None])
+        dispatch = dispatch | disp_j
+        combine = combine + disp_j * gate_vals[:, j][:, None, None]
+        fill = fill + onehot.sum(axis=0)
+
+    # load-balance auxiliary loss (Switch eq. 4)
+    me = probs.mean(axis=0)                                # (E,)
+    ce = dispatch.any(axis=-1).astype(jnp.float32).mean(axis=0)
+    aux = E * jnp.sum(me * ce)
+    return dispatch, combine, aux
+
+
+def moe_dispatch_combine(x, gate_logits, w1, b1, w2, b2, top_k=2,
+                         capacity_factor=1.25, activation=jax.nn.gelu):
+    """The full MoE FFN on flat tokens. x: (S, C); gate_logits: (S, E);
+    stacked expert weights w1 (E, C, H), b1 (E, H), w2 (E, H, C),
+    b2 (E, C). Returns (y (S, C), aux_loss)."""
+    S, C = x.shape
+    E = w1.shape[0]
+    capacity = max(1, int(S * top_k * capacity_factor / E))
+    dispatch, combine, aux = top_k_gating(gate_logits, top_k, capacity)
+    xin = x.astype(jnp.float32)
+    # dispatch all-to-all: (S, E, Cap) × (S, C) → (E, Cap, C)
+    expert_in = jnp.einsum("sec,sm->ecm", dispatch.astype(xin.dtype), xin)
+    h = activation(jnp.einsum("ecm,emh->ech", expert_in, w1.astype(
+        jnp.float32)) + b1[:, None, :].astype(jnp.float32))
+    expert_out = jnp.einsum("ech,ehm->ecm", h, w2.astype(jnp.float32)) \
+        + b2[:, None, :].astype(jnp.float32)
+    # combine all-to-all back to tokens
+    y = jnp.einsum("sec,ecm->sm", combine, expert_out)
+    return y.astype(x.dtype), aux.astype(x.dtype)
+
+
+def all_to_all_tokens(x, mesh=None, axis=AXIS_EP, split_dim=1, concat_dim=0):
+    """Explicit token redistribution over the ep axis (lax.all_to_all in a
+    shard_map) — the collective a token-sharded dispatch rides. x: global
+    (S, E_local_dim, ...) array; its axis-`concat_dim` shards over `axis`
+    in, axis-`split_dim` shards over `axis` out."""
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        raise MXNetError(f"all_to_all_tokens needs a mesh with {axis!r}")
+
+    def local(xb):
+        return lax.all_to_all(xb, axis, split_dim, concat_dim, tiled=True)
+
+    spec_in = [None] * x.ndim
+    spec_in[concat_dim] = axis
+    spec_out = [None] * x.ndim
+    spec_out[split_dim] = axis
+    fn = shard_map_compat(local, mesh=mesh,
+                          in_specs=PartitionSpec(*spec_in),
+                          out_specs=PartitionSpec(*spec_out),
+                          check_rep=False)
+    return fn(x)

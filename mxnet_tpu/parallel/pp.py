@@ -1,0 +1,95 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over the "pp"
+mesh axis.
+
+Reference parity: none — the reference's only model parallelism is manual
+per-layer `group2ctx` device assignment executed by the engine (SURVEY.md
+§2.4 'Model parallelism (manual)'); the brief makes PP first-class here.
+
+TPU-native design (SURVEY.md §7.2 M8): all pipeline stages must be
+structurally identical (the transformer-block case); their parameters are
+STACKED along a leading stage axis and sharded over "pp", so each device
+holds exactly one stage. A `shard_map` then runs the classic
+collective-permute pipeline: each step every device applies its stage to
+its current microbatch and `ppermute`s the activation to the next stage,
+stage 0 feeding a fresh microbatch per step. The schedule is plain GPipe
+— M + (P-1) steps for M microbatches over P stages, bubble fraction
+(P-1)/(M+P-1) — compiled into ONE XLA program (a lax.fori_loop of
+MXU work + ICI ppermutes).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .mesh import AXIS_PP, PartitionSpec, current_mesh, shard_map_compat
+
+__all__ = ["gpipe", "stack_stage_params"]
+
+
+def stack_stage_params(stage_param_trees):
+    """Stack N structurally-identical per-stage pytrees along a new
+    leading stage axis (the layout gpipe shards over "pp")."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *stage_param_trees)
+
+
+def gpipe(stage_fn, stacked_params, x, n_microbatches, mesh=None,
+          axis=AXIS_PP):
+    """Run `x` through P pipeline stages over the mesh's "pp" axis.
+
+    stage_fn(stage_params, mb) -> mb_out — one stage's computation on one
+    microbatch; activations must keep the same shape/dtype through every
+    stage (transformer-block contract). stacked_params: pytree with
+    leading stage axis (see stack_stage_params). x: (B, ...) global
+    batch; B must divide into n_microbatches. Returns (B, ...), equal to
+    applying the stages sequentially (GPipe is an exact-compute schedule,
+    not an approximation).
+    """
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        raise MXNetError(
+            f"gpipe needs an active mesh with a {axis!r} axis")
+    n_stages = mesh.shape[axis]
+    n_stage_params = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_stage_params != n_stages:
+        raise MXNetError(
+            f"{n_stage_params} stacked stages != pp axis size {n_stages}")
+    B = x.shape[0]
+    M = int(n_microbatches)
+    if B % M:
+        raise MXNetError(f"batch {B} not divisible into {M} microbatches")
+    mb = B // M
+
+    def local(params, xs):
+        # params: this stage's slice, leading dim 1 → squeeze
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        stage = lax.axis_index(axis)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        xs = xs.reshape((M, mb) + xs.shape[1:])
+        state0 = jnp.zeros_like(xs[0])
+        ys0 = jnp.zeros_like(xs)
+
+        def step(t, carry):
+            state, ys = carry
+            # stage 0 feeds microbatch t (mod M keeps indices legal in the
+            # drain phase; those outputs are never recorded)
+            inp = jnp.where(stage == 0, xs[t % M], state)
+            out = stage_fn(params, inp)
+            slot = (t - (n_stages - 1)) % M
+            take = (stage == n_stages - 1) & (t >= n_stages - 1)
+            ys = ys.at[slot].set(jnp.where(take, out, ys[slot]))
+            state = lax.ppermute(out, axis, perm)
+            return state, ys
+
+        _, ys = lax.fori_loop(0, M + n_stages - 1, step, (state0, ys0))
+        # result lives on the last stage; one-hot psum replicates it (the
+        # cheap exit collective; callers slice further shardings on top)
+        ys = lax.psum(jnp.where(stage == n_stages - 1, ys, 0.0), axis)
+        return ys.reshape((B,) + ys.shape[2:])
+
+    fn = shard_map_compat(local, mesh=mesh,
+                          in_specs=(PartitionSpec(axis), PartitionSpec()),
+                          out_specs=PartitionSpec(), check_rep=False)
+    return fn(stacked_params, x)

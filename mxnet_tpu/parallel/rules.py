@@ -15,7 +15,7 @@ from ..base import MXNetError
 from .mesh import PartitionSpec
 
 __all__ = ["ShardingRules", "apply_sharding_rules", "megatron_dense_rules",
-           "fsdp_rules"]
+           "fsdp_rules", "ep_rules"]
 
 
 class ShardingRules:
@@ -81,6 +81,16 @@ def megatron_dense_rules(tp_axis="tp", fsdp_axis=None):
     rules.add(r"embed\w*\.weight$", PartitionSpec(tp_axis, fsdp_axis))
     if fsdp_axis is not None:
         rules.default = None  # leave rest replicated; fsdp via explicit specs
+    return rules
+
+
+def ep_rules(ep_axis="ep"):
+    """Expert parallelism: MoEFFN's stacked expert weights (leading dim =
+    expert index, gluon/nn/moe.py naming `expert_*`) shard dim 0 over
+    `ep_axis`; XLA partitions the expert einsums and inserts the
+    dispatch/combine collectives (SURVEY.md §2.4 presence matrix: EP)."""
+    rules = ShardingRules()
+    rules.add(r"expert_\w+$", PartitionSpec(ep_axis))
     return rules
 
 

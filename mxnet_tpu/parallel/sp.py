@@ -15,14 +15,10 @@ import functools
 
 import jax
 from jax.sharding import PartitionSpec as P
-try:  # jax >= 0.4.35
-    from jax.experimental.shard_map import shard_map
-except ImportError:  # pragma: no cover - newer jax moved it
-    from jax.sharding import shard_map
 
 from ..base import MXNetError
 from ..ops.attention import ring_attention_data
-from .mesh import AXIS_SP, current_mesh
+from .mesh import AXIS_SP, current_mesh, shard_map_compat
 
 __all__ = ["ring_attention", "sp_enabled"]
 
@@ -76,6 +72,6 @@ def ring_attention(q, k, v, mask=None, causal=False, scale=None, mesh=None,
             return ring_attention_data(qb, kb, vb, sp_axis, causal=causal,
                                        scale=scale)
 
-    fn = shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
-                   out_specs=qspec, check_rep=False)
+    fn = shard_map_compat(local, mesh=mesh, in_specs=tuple(in_specs),
+                          out_specs=qspec, check_rep=False)
     return fn(*args)

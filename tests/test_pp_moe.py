@@ -1,0 +1,228 @@
+"""Pipeline parallelism (gpipe) and MoE/expert-parallelism tests on the
+8-device virtual CPU mesh — SURVEY.md §2.4's absent-in-reference flavors
+that the brief makes first-class. Oracles: pp == sequential stages;
+identical experts == dense FFN; ep-sharded == unsharded."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel as par
+from mxnet_tpu.base import MXNetError
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh")
+
+
+# ---------------------------------------------------------------------------
+# pipeline parallelism
+# ---------------------------------------------------------------------------
+
+def _stage_fn(params, x):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def _mk_stages(n, d, h, seed=0):
+    r = np.random.default_rng(seed)
+    return [
+        {"w1": jnp.asarray(r.standard_normal((d, h)) * 0.3, jnp.float32),
+         "b1": jnp.zeros((h,), jnp.float32),
+         "w2": jnp.asarray(r.standard_normal((h, d)) * 0.3, jnp.float32),
+         "b2": jnp.zeros((d,), jnp.float32)}
+        for _ in range(n)
+    ]
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(4, 4), (2, 8)])
+def test_gpipe_matches_sequential(n_stages, n_micro):
+    d, h, B = 6, 10, 8
+    stages = _mk_stages(n_stages, d, h)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((B, d)),
+                    jnp.float32)
+    want = x
+    for p in stages:
+        want = _stage_fn(p, want)
+
+    mesh = par.make_mesh(pp=n_stages, devices=jax.devices()[:n_stages])
+    stacked = par.stack_stage_params(stages)
+    got = par.gpipe(_stage_fn, stacked, x, n_microbatches=n_micro,
+                    mesh=mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_gpipe_under_jit_and_grad():
+    """gpipe composes under jit and reverse-mode AD (training path)."""
+    d, h, B, n = 4, 6, 4, 2
+    stages = _mk_stages(n, d, h, seed=2)
+    stacked = par.stack_stage_params(stages)
+    mesh = par.make_mesh(pp=n, devices=jax.devices()[:n])
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((B, d)),
+                    jnp.float32)
+
+    def loss_pp(params):
+        return par.gpipe(_stage_fn, params, x, 2, mesh=mesh).sum()
+
+    def loss_seq(stages_list):
+        y = x
+        for p in stages_list:
+            y = _stage_fn(p, y)
+        return y.sum()
+
+    g_pp = jax.jit(jax.grad(loss_pp))(stacked)
+    g_seq = jax.grad(loss_seq)(stages)
+    for i in range(n):
+        for k in stages[0]:
+            np.testing.assert_allclose(np.asarray(g_pp[k][i]),
+                                       np.asarray(g_seq[i][k]),
+                                       rtol=2e-4, atol=2e-6)
+
+
+def test_gpipe_validates():
+    stages = _mk_stages(2, 4, 6)
+    stacked = par.stack_stage_params(stages)
+    mesh = par.make_mesh(pp=2, devices=jax.devices()[:2])
+    x = jnp.zeros((4, 4), jnp.float32)
+    with pytest.raises(MXNetError, match="microbatch"):
+        par.gpipe(_stage_fn, stacked, x, 3, mesh=mesh)
+    mesh4 = par.make_mesh(pp=4, devices=jax.devices()[:4])
+    with pytest.raises(MXNetError, match="stage"):
+        par.gpipe(_stage_fn, stacked, x, 2, mesh=mesh4)
+    with pytest.raises(MXNetError, match="pp"):
+        par.gpipe(_stage_fn, stacked, x, 2,
+                  mesh=par.make_mesh(dp=2, devices=jax.devices()[:2]))
+
+
+# ---------------------------------------------------------------------------
+# MoE / expert parallelism
+# ---------------------------------------------------------------------------
+
+def _moe_weights(E, C, H, seed=0, identical=False):
+    r = np.random.default_rng(seed)
+    if identical:
+        w1 = np.broadcast_to(r.standard_normal((1, C, H)), (E, C, H))
+        w2 = np.broadcast_to(r.standard_normal((1, H, C)), (E, H, C))
+    else:
+        w1 = r.standard_normal((E, C, H))
+        w2 = r.standard_normal((E, H, C))
+    return (jnp.asarray(w1 * 0.3, jnp.float32),
+            jnp.zeros((E, H), jnp.float32),
+            jnp.asarray(w2 * 0.3, jnp.float32),
+            jnp.zeros((E, C), jnp.float32))
+
+
+def test_moe_identical_experts_equal_dense_ffn():
+    """With identical experts and ample capacity, top-k routing must give
+    exactly the dense FFN output (combine weights renormalize to 1)."""
+    S, C, H, E = 16, 8, 12, 4
+    r = np.random.default_rng(1)
+    x = jnp.asarray(r.standard_normal((S, C)), jnp.float32)
+    logits = jnp.asarray(r.standard_normal((S, E)), jnp.float32)
+    w1, b1, w2, b2 = _moe_weights(E, C, H, identical=True)
+    y, aux = par.moe_dispatch_combine(x, logits, w1, b1, w2, b2, top_k=2,
+                                      capacity_factor=4.0)
+    dense = jax.nn.gelu(x @ w1[0] + b1[0]) @ w2[0] + b2[0]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(dense),
+                               rtol=2e-4, atol=2e-5)
+    assert np.isfinite(float(aux)) and float(aux) > 0
+
+
+def test_moe_capacity_drops_tokens():
+    S, C, H, E = 8, 4, 6, 2
+    r = np.random.default_rng(2)
+    x = jnp.asarray(r.standard_normal((S, C)), jnp.float32)
+    # route EVERY token to expert 0 with k=1 → fill exceeds tiny capacity
+    logits = jnp.tile(jnp.asarray([[5.0, -5.0]]), (S, 1))
+    w1, b1, w2, b2 = _moe_weights(E, C, H)
+    y, _ = par.moe_dispatch_combine(x, logits, w1, b1, w2, b2, top_k=1,
+                                    capacity_factor=0.5)
+    out = np.asarray(y)
+    cap = max(1, int(S * 1 * 0.5 / E))
+    assert (np.abs(out[:cap]).sum(axis=1) > 0).all()
+    np.testing.assert_array_equal(out[cap:], 0.0)  # dropped tokens → 0
+
+
+def test_moe_ep_sharded_matches_unsharded():
+    """Expert weights sharded over ep (XLA-partitioned einsums +
+    collectives) must not change the numerics."""
+    S, C, H, E = 32, 8, 16, 4
+    r = np.random.default_rng(3)
+    x = jnp.asarray(r.standard_normal((S, C)), jnp.float32)
+    logits = jnp.asarray(r.standard_normal((S, E)), jnp.float32)
+    weights = _moe_weights(E, C, H)
+
+    def f(x, logits, w1, b1, w2, b2):
+        y, aux = par.moe_dispatch_combine(x, logits, w1, b1, w2, b2,
+                                          top_k=2, capacity_factor=2.0)
+        return y, aux
+
+    y_ref, aux_ref = jax.jit(f)(x, logits, *weights)
+
+    mesh = par.make_mesh(ep=4, devices=jax.devices()[:4])
+    ep = par.PartitionSpec("ep")
+    with par.mesh_scope(mesh):
+        sharded = tuple(
+            jax.device_put(w, par.named_sharding(ep)) for w in weights)
+        y_ep, aux_ep = jax.jit(f)(x, logits, *sharded)
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_ref),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(float(aux_ep), float(aux_ref), rtol=1e-5)
+
+
+def test_all_to_all_tokens_roundtrip():
+    mesh = par.make_mesh(ep=4, devices=jax.devices()[:4])
+    x = jnp.arange(4 * 8 * 3, dtype=jnp.float32).reshape(8, 4, 3)
+    y = par.all_to_all_tokens(x, mesh=mesh, axis="ep", split_dim=1,
+                              concat_dim=0)
+    assert y.shape == x.shape
+    # a second all-to-all with swapped dims inverts the first
+    z = par.all_to_all_tokens(y, mesh=mesh, axis="ep", split_dim=0,
+                              concat_dim=1)
+    np.testing.assert_array_equal(np.asarray(z), np.asarray(x))
+
+
+def test_moe_ffn_layer_trains():
+    """MoEFFN gluon layer: forward shape, eager autograd, loss decreases
+    under the fused TrainStep with ep sharding rules applied."""
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.gluon import loss as gloss, nn
+
+    B, T, C, H, E = 4, 6, 8, 16, 4
+
+    class Net(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.moe = nn.MoEFFN(C, H, num_experts=E, top_k=2)
+            self.head = nn.Dense(2, flatten=False, in_units=C)
+
+        def forward(self, x):
+            return self.head(self.moe(x))
+
+    net = Net()
+    mx.rng.seed(5)
+    net.initialize(mx.init.Normal(0.1))
+    par.apply_sharding_rules(net, par.ep_rules())
+    assert tuple(net.moe.expert_w1.sharding) == ("ep",)
+
+    x = mx.nd.array(np.random.default_rng(6).standard_normal((B, T, C)),
+                    dtype="float32")
+    y = mx.nd.array(np.random.default_rng(7).integers(0, 2, (B, T)),
+                    dtype="int32")
+    # eager grads flow
+    with mx.autograd.record():
+        out = net(x)
+        loss = gloss.SoftmaxCrossEntropyLoss()(out, y)
+    loss.backward()
+    assert net.moe.expert_w1.grad() is not None
+    assert float(np.abs(net.moe.expert_w1.grad().asnumpy()).sum()) > 0
+
+    mesh = par.make_mesh(dp=2, ep=4)
+    step = par.TrainStep(net, gloss.SoftmaxCrossEntropyLoss(),
+                         opt.Adam(learning_rate=3e-3), mesh=mesh,
+                         batch_specs=(par.PartitionSpec("dp"),
+                                      par.PartitionSpec("dp")))
+    losses = [float(step(x, y).asscalar()) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
