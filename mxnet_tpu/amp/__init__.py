@@ -1,0 +1,128 @@
+"""mx.amp — automatic mixed precision.
+
+Reference parity: python/mxnet/contrib/amp/ (v2: python/mxnet/amp/) —
+`init()`, `init_trainer()`, `scale_loss()`, `unscale()`,
+`convert_model`/`convert_hybrid_block`, backed by loss_scaler.py's dynamic
+LossScaler and the fp16-safe / fp32-forced op lists (lists/symbol_fp16.py).
+
+TPU-native design (SURVEY.md §2.5 AMP row): the reference monkey-patches
+op namespaces to insert amp_cast pairs; here precision is a MODEL-LEVEL
+policy — `convert_model` casts parameters (norm/loss-sensitive layers
+excepted) and XLA propagates the dtypes through the fused program, which
+is where cast insertion belongs on TPU. bfloat16 is the native target and
+needs NO loss scaling (same exponent range as fp32); the fp16 path keeps
+the reference's dynamic-loss-scaler contract for API/semantics parity.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from ..base import MXNetError
+from .loss_scaler import LossScaler
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_model",
+           "convert_hybrid_block", "LossScaler", "amp_state"]
+
+_state = {"initialized": False, "target_dtype": None}
+
+# layers whose parameters stay float32 (the reference's FP32_FUNCS list,
+# layer-level: norms accumulate/divide and are range-sensitive)
+_FP32_LAYERS = ("BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
+                "SyncBatchNorm")
+
+
+def amp_state():
+    return dict(_state)
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP (parity: amp.init). target_dtype: 'bfloat16' (TPU
+    native) or 'float16' (reference default; needs loss scaling).
+    The *_ops lists are accepted for API parity; op-level cast insertion
+    is subsumed by XLA dtype propagation from the converted model."""
+    if target_dtype not in ("bfloat16", "float16", "bf16", "fp16"):
+        raise MXNetError(f"unsupported AMP target_dtype {target_dtype!r}")
+    _state["target_dtype"] = {"bf16": "bfloat16", "fp16": "float16"}.get(
+        target_dtype, target_dtype)
+    _state["initialized"] = True
+
+
+def _check_initialized():
+    if not _state["initialized"]:
+        raise MXNetError("call amp.init() before other amp functions")
+
+
+def init_trainer(trainer, loss_scaler=None):
+    """Attach a dynamic loss scaler to a gluon Trainer (parity:
+    amp.init_trainer). With bfloat16 the scaler is a no-op shell (scale
+    1.0) since bf16 has fp32's exponent range."""
+    _check_initialized()
+    if loss_scaler is None:
+        if _state["target_dtype"] == "bfloat16":
+            loss_scaler = LossScaler(init_scale=1.0, scale_window=10 ** 9)
+        else:
+            loss_scaler = LossScaler()
+    trainer._amp_loss_scaler = loss_scaler
+    return trainer
+
+
+@contextmanager
+def scale_loss(loss, trainer):
+    """Context manager yielding the scaled loss to backward() through
+    (parity: amp.scale_loss):
+
+        with amp.scale_loss(loss, trainer) as scaled:
+            autograd.backward(scaled)
+        trainer.step(batch_size)   # unscales, checks overflow, updates
+    """
+    _check_initialized()
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise MXNetError("trainer not amp-initialized: call "
+                         "amp.init_trainer(trainer) first")
+    s = scaler.loss_scale
+    if isinstance(loss, (list, tuple)):
+        yield type(loss)(l * s for l in loss)
+    else:
+        yield loss * s
+
+
+def unscale(trainer):
+    """Divide the trainer's parameter gradients by the current loss scale
+    in place (parity: amp.unscale — for gradient clipping between
+    backward and step)."""
+    _check_initialized()
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise MXNetError("trainer not amp-initialized")
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req != "null" and p._data is not None:
+            g = p.grad()
+            if g is not None:
+                g._rebind((g._data * inv).astype(g._data.dtype))
+    scaler._unscaled = True
+
+
+def convert_model(net, target_dtype=None):
+    """Cast a model's parameters to the AMP dtype, keeping norm-layer
+    parameters in float32 (parity: amp.convert_model — the reference's
+    FP32_FUNCS list applied at layer granularity; XLA inserts the actual
+    casts where dtypes meet)."""
+    if target_dtype is None:
+        _check_initialized()
+        target_dtype = _state["target_dtype"]
+
+    def walk(block):
+        if type(block).__name__ not in _FP32_LAYERS:
+            for p in block._reg_params.values():
+                p.cast(target_dtype)
+        for child in block._children.values():
+            walk(child)
+
+    walk(net)
+    return net
+
+
+convert_hybrid_block = convert_model

@@ -1,0 +1,50 @@
+"""Dynamic loss scaler.
+
+Reference parity: python/mxnet/contrib/amp/loss_scaler.py — multiply the
+loss by `loss_scale` before backward so fp16 gradients stay in range,
+check gradients for inf/nan after backward, skip the update and halve the
+scale on overflow, double it after `scale_window` clean steps.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.0):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = float(scale_factor)
+        self._scale_window = int(scale_window)
+        self._unskipped = 0
+
+    @property
+    def is_noop(self):
+        """True for the bfloat16 shell (scale pinned at 1.0, window never
+        reached): overflow checking can be skipped entirely."""
+        return self.loss_scale == 1.0 and self._scale_window >= 10 ** 9
+
+    def has_overflow(self, params):
+        """True iff any gradient of `params` is non-finite — the
+        reference's multi_all_finite check. Reduces ON DEVICE (one scalar
+        OR across all grads) and fetches a single byte, instead of
+        copying every gradient to host."""
+        bad = None
+        for p in params:
+            g = p.grad()
+            if g is None:
+                continue
+            b = ~jnp.isfinite(g._data).all()
+            bad = b if bad is None else (bad | b)
+        return False if bad is None else bool(bad)
+
+    def update_scale(self, overflow):
+        """Dynamic adjustment (parity: LossScaler.update_scale)."""
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

@@ -77,9 +77,25 @@ class Trainer:
     # -- the step ----------------------------------------------------------
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce (no-op single-device) + update with grads rescaled by
-        1/batch_size (parity: Trainer.step)."""
-        self._optimizer.rescale_grad = self._scale / batch_size
+        1/batch_size (parity: Trainer.step). With amp.init_trainer active,
+        also unscales by the dynamic loss scale, skips the update on
+        overflow, and adjusts the scale (reference amp trainer patching,
+        contrib/amp/amp.py)."""
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        scale = self._scale / batch_size
+        if scaler is not None and not getattr(scaler, "_unscaled", False):
+            scale /= scaler.loss_scale
+        if scaler is not None:
+            scaler._unscaled = False
+        self._optimizer.rescale_grad = scale
         self.allreduce_grads()
+        if scaler is not None and not scaler.is_noop:
+            overflow = scaler.has_overflow(
+                [p for p in self._params if p.grad_req != "null"])
+            scaler.update_scale(overflow)
+            if overflow:
+                self.zero_grad()  # skip the update, drop the bad grads
+                return
         self._update(ignore_stale_grad)
 
     def allreduce_grads(self):

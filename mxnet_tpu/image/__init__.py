@@ -1,0 +1,361 @@
+"""mx.image — image decode, resize/crop, and augmenters.
+
+Reference parity: python/mxnet/image/image.py (imdecode/imread/imresize,
+fixed_crop/center_crop/random_crop/resize_short, the Augmenter zoo,
+ImageIter) and src/operator/image/ (to_tensor/normalize device ops). The
+reference decodes through OpenCV; so does this module (cv2 is the decode
+backend here too, with a PIL fallback), keeping BGR-file → RGB-NDArray
+semantics and (H, W, C) uint8 layout. Device-side tensor ops
+(to_tensor/normalize) live in jax and fuse into the consuming program.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["imdecode", "imread", "imresize", "imrotate", "resize_short",
+           "fixed_crop", "center_crop", "random_crop", "color_normalize",
+           "to_tensor", "normalize", "Augmenter", "ResizeAug",
+           "ForceResizeAug", "RandomCropAug", "CenterCropAug",
+           "HorizontalFlipAug", "CastAug", "BrightnessJitterAug",
+           "ContrastJitterAug", "SaturationJitterAug", "ColorJitterAug",
+           "LightingAug", "CreateAugmenter"]
+
+
+def _cv2():
+    try:
+        import cv2
+        return cv2
+    except ImportError:
+        return None
+
+
+def _decode_np(buf, flag=1, to_rgb=True):
+    cv2 = _cv2()
+    arr = _np.frombuffer(buf, dtype=_np.uint8)
+    if cv2 is not None:
+        img = cv2.imdecode(arr, 1 if flag else 0)
+        if img is None:
+            raise MXNetError("imdecode failed: invalid image data")
+        if flag and to_rgb:
+            img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+        return img if flag else img[..., None]
+    try:  # PIL fallback
+        import io
+        from PIL import Image
+        img = Image.open(io.BytesIO(buf))
+        img = img.convert("RGB" if flag else "L")
+        out = _np.asarray(img)
+        return out if flag else out[..., None]
+    except ImportError:
+        raise MXNetError("imdecode needs cv2 or PIL; neither is available")
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode a compressed image buffer to an (H, W, C) uint8 NDArray
+    (parity: mx.image.imdecode; RGB order when to_rgb, like the
+    reference)."""
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    img = NDArray(jnp.asarray(_decode_np(bytes(buf), flag, to_rgb)))
+    if out is not None:
+        out._assign_from(img)
+        return out
+    return img
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read + decode an image file (parity: mx.image.imread)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def _interp_method(interp):
+    cv2 = _cv2()
+    if cv2 is None:
+        return None
+    return {0: cv2.INTER_NEAREST, 1: cv2.INTER_LINEAR, 2: cv2.INTER_CUBIC,
+            3: cv2.INTER_AREA, 4: cv2.INTER_LANCZOS4}.get(interp,
+                                                          cv2.INTER_LINEAR)
+
+
+def imresize(src, w, h, interp=1):
+    """Resize (H, W, C) to (h, w, C) (parity: mx.image.imresize)."""
+    cv2 = _cv2()
+    a = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    if cv2 is not None:
+        out = cv2.resize(a, (w, h), interpolation=_interp_method(interp))
+        if out.ndim == 2:
+            out = out[..., None]
+    else:
+        import jax
+        method = "nearest" if interp == 0 else "bilinear"
+        out = _np.asarray(jax.image.resize(
+            jnp.asarray(a, jnp.float32), (h, w, a.shape[2]), method=method))
+        if a.dtype == _np.uint8:
+            out = _np.clip(_np.round(out), 0, 255).astype(_np.uint8)
+    return NDArray(jnp.asarray(out))
+
+
+def imrotate(src, rotation_degrees, zoom_in=False, zoom_out=False):
+    """Rotate about the center (parity: mx.image.imrotate)."""
+    cv2 = _cv2()
+    if cv2 is None:
+        raise MXNetError("imrotate requires cv2")
+    a = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    h, w = a.shape[:2]
+    m = cv2.getRotationMatrix2D((w / 2, h / 2), float(rotation_degrees), 1.0)
+    out = cv2.warpAffine(a, m, (w, h))
+    if out.ndim == 2:
+        out = out[..., None]
+    return NDArray(jnp.asarray(out))
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the SHORTER edge equals `size`, keeping aspect (parity:
+    mx.image.resize_short — the standard eval-pipeline first step)."""
+    h, w = (src.shape[0], src.shape[1])
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    a = src if isinstance(src, NDArray) else NDArray(jnp.asarray(src))
+    out = NDArray(a._data[y0:y0 + h, x0:x0 + w])
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=2):
+    """Returns (cropped, (x0, y0, w, h)) (parity: mx.image.center_crop)."""
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = size
+    x0 = int((w - new_w) / 2)
+    y0 = int((h - new_h) / 2)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    """Returns (cropped, (x0, y0, w, h)) (parity: mx.image.random_crop)."""
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = size
+    x0 = int(_np.random.randint(0, max(w - new_w, 0) + 1))
+    y0 = int(_np.random.randint(0, max(h - new_h, 0) + 1))
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    x = src._data if isinstance(src, NDArray) else jnp.asarray(src)
+    x = x.astype(jnp.float32) - jnp.asarray(mean, jnp.float32)
+    if std is not None:
+        x = x / jnp.asarray(std, jnp.float32)
+    return NDArray(x)
+
+
+def to_tensor(src):
+    """(H, W, C) uint8 [0,255] → (C, H, W) float32 [0,1] (parity:
+    src/operator/image/image_random.cc ToTensor; runs in jax so it fuses
+    into the consuming program)."""
+    x = src._data if isinstance(src, NDArray) else jnp.asarray(src)
+    x = x.astype(jnp.float32) / 255.0
+    axes = (2, 0, 1) if x.ndim == 3 else (0, 3, 1, 2)
+    return NDArray(jnp.transpose(x, axes))
+
+
+def normalize(src, mean, std):
+    """Channel-wise normalize a (C, H, W) tensor (parity: image
+    Normalize)."""
+    x = src._data if isinstance(src, NDArray) else jnp.asarray(src)
+    mean = jnp.asarray(mean, jnp.float32).reshape(-1, 1, 1)
+    std = jnp.asarray(std, jnp.float32).reshape(-1, 1, 1)
+    return NDArray((x - mean) / std)
+
+
+# ---------------------------------------------------------------------------
+# augmenters (parity: mx.image.Augmenter zoo)
+# ---------------------------------------------------------------------------
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self._size, self._interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self._size, self._interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self._size, self._interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self._size[0], self._size[1], self._interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self._size, self._interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self._size, self._interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self._size, self._interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self._size, self._interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self._p = p
+
+    def __call__(self, src):
+        if _np.random.random() < self._p:
+            return NDArray(src._data[:, ::-1])
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(typ=typ)
+        self._typ = typ
+
+    def __call__(self, src):
+        return NDArray(src._data.astype(self._typ))
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self._b = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _np.random.uniform(-self._b, self._b)
+        return NDArray(src._data.astype(jnp.float32) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = jnp.asarray([0.299, 0.587, 0.114], jnp.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self._c = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + _np.random.uniform(-self._c, self._c)
+        x = src._data.astype(jnp.float32)
+        gray = (x * self._coef).sum(axis=-1, keepdims=True)
+        mean = gray.mean()
+        return NDArray(x * alpha + mean * (1 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = ContrastJitterAug._coef
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self._s = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + _np.random.uniform(-self._s, self._s)
+        x = src._data.astype(jnp.float32)
+        gray = (x * self._coef).sum(axis=-1, keepdims=True)
+        return NDArray(x * alpha + gray * (1 - alpha))
+
+
+class ColorJitterAug(Augmenter):
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0):
+        super().__init__(brightness=brightness, contrast=contrast,
+                         saturation=saturation)
+        self._augs = []
+        if brightness:
+            self._augs.append(BrightnessJitterAug(brightness))
+        if contrast:
+            self._augs.append(ContrastJitterAug(contrast))
+        if saturation:
+            self._augs.append(SaturationJitterAug(saturation))
+
+    def __call__(self, src):
+        order = _np.random.permutation(len(self._augs))
+        for i in order:
+            src = self._augs[i](src)
+        return src
+
+
+class LightingAug(Augmenter):
+    """PCA-based lighting noise (AlexNet-style; parity:
+    mx.image.LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self._alphastd = alphastd
+        self._eigval = _np.asarray(eigval, _np.float32)
+        self._eigvec = _np.asarray(eigvec, _np.float32)
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0, self._alphastd, size=(3,))
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return NDArray(src._data.astype(jnp.float32) +
+                       jnp.asarray(rgb, jnp.float32))
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Build the standard augmenter list (parity: mx.image.CreateAugmenter
+    — the ImageIter training pipeline recipe)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = [55.46, 4.794, 1.148]
+        eigvec = [[-0.5675, 0.7192, 0.4009],
+                  [-0.5808, -0.0045, -0.814],
+                  [-0.5836, -0.6948, 0.4203]]
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is not None or std is not None:
+        class _NormAug(Augmenter):
+            def __call__(self, src):
+                return color_normalize(src, mean if mean is not None else 0,
+                                       std)
+        auglist.append(_NormAug())
+    return auglist

@@ -15,6 +15,8 @@ the reference generates stubs from the C registry).
 from __future__ import annotations
 
 import functools
+import sys
+import time as _time
 
 import jax
 
@@ -23,6 +25,13 @@ from ..autograd import is_recording, is_tracked, record_node
 from ..base import MXNetError, Registry
 
 OPS = Registry("operator")
+
+
+def _profiler_active():
+    # zero-overhead when the profiler module was never imported
+    prof = sys.modules.get("mxnet_tpu.profiler")
+    return prof is not None and prof.is_active() \
+        and prof._config["profile_imperative"]
 
 
 def _nd():
@@ -43,17 +52,24 @@ def apply_op(name, closed_fn, array_args, out=None, nodiff=False):
         and is_recording()
         and any(is_tracked(a) for a in array_args)
     )
+    prof = _profiler_active()
+    t0 = _time.perf_counter() if prof else 0.0
     if rec:
         out_data, vjp_fn = jax.vjp(closed_fn, *datas)
     else:
         out_data = closed_fn(*datas)
     multi = isinstance(out_data, (tuple, list))
     out_list = list(out_data) if multi else [out_data]
-    if _engine.is_sync():
-        # NaiveEngine debug mode: surface async errors at the faulting op
+    if _engine.is_sync() or prof:
+        # NaiveEngine debug mode: surface async errors at the faulting op.
+        # Profiling syncs too, so per-op wall time is attribution-accurate
+        # (the reference's NaiveEngine profiling recipe, SURVEY.md §5.2).
         for d in out_list:
             if hasattr(d, "block_until_ready"):
                 d.block_until_ready()
+    if prof:
+        from .. import profiler as _prof
+        _prof.record_op(name, _time.perf_counter() - t0)
     outs = [NDArray(d) for d in out_list]
     if rec:
         record_node(name, vjp_fn, array_args, outs, multi=multi)

@@ -39,6 +39,12 @@ def _spec_or_replicated(spec):
     return spec if spec is not None else PartitionSpec()
 
 
+def _mesh_ctx(mesh):
+    """Scope for trace-inducing calls: ops (attention impl='auto') consult
+    current_mesh() during tracing to pick sharded routes."""
+    return mesh_scope(mesh) if mesh is not None else _nullcontext()
+
+
 class TrainStep:
     """Compile net+loss+optimizer into one sharded step program.
 
@@ -237,9 +243,7 @@ class TrainStep:
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                 (tuple(self._param_arrays), self._opt_states, self._t,
                  key, lr, wd) + datas)
-        # trace (first call) must see the mesh: ops like attention
-        # impl='auto' consult current_mesh() to pick the sp/ring path
-        with self._mesh_ctx():
+        with _mesh_ctx(self.mesh):
             out = self._jitted(tuple(self._param_arrays), self._opt_states,
                                self._t, key, lr, wd, *datas)
         self._param_arrays, self._opt_states, self._t, loss, aux = out
@@ -276,14 +280,10 @@ class TrainStep:
         except Exception:
             return None
 
-    def _mesh_ctx(self):
-        return mesh_scope(self.mesh) if self.mesh is not None \
-            else _nullcontext()
-
     def _lowered(self):
         """AOT-lower the step program (re-traces; mesh scope active so the
         trace takes the same op routes as the live step)."""
-        with self._mesh_ctx():
+        with _mesh_ctx(self.mesh):
             return self._jitted.lower(*self._lower_args)
 
 
@@ -336,8 +336,7 @@ class EvalStep:
             self._jitted = self._build(len(datas))
         key = _rng.next_key()
         param_datas = tuple(p.data()._data for p in self._params)
-        with (mesh_scope(self.mesh) if self.mesh is not None
-              else _nullcontext()):
+        with _mesh_ctx(self.mesh):
             outs = self._jitted(param_datas, key, *datas)
         res = tuple(NDArray(o) for o in outs)
         return res[0] if len(res) == 1 else res

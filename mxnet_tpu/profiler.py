@@ -1,0 +1,212 @@
+"""mx.profiler — scoped ranges, per-op aggregate stats, device traces.
+
+Reference parity: python/mxnet/profiler.py over src/profiler/profiler.cc
+(SURVEY.md §5.1): `set_config` / `set_state('run'|'stop')` /
+`pause`/`resume` / `dumps` (aggregate per-op table, the
+MXAggregateProfileStatsPrint analog) / scope objects
+(ProfileTask/ProfileEvent analogs) / chrome-trace output.
+
+TPU-native mapping: the device timeline comes from `jax.profiler`
+(XPlane → TensorBoard/perfetto, started and stopped by set_state when a
+trace dir is configured) — XLA already records every fused kernel, which
+is what the reference's per-engine-op timestamps were. The MXNet-parity
+work is the API: scoped ranges annotate the jax trace via
+TraceAnnotation, and the per-op aggregate table is measured at the eager
+dispatch funnel (ops/registry.apply_op) — per-op wall times with a sync
+per op when `aggregate_stats=True`, the same serialization the
+reference's NaiveEngine profiling mode accepts for accurate attribution.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["set_config", "set_state", "state", "pause", "resume", "dumps",
+           "dump", "Scope", "scope", "Task", "Event", "Counter",
+           "server_trace_dir"]
+
+_lock = threading.Lock()
+_config = {
+    "filename": "profile.json",
+    "trace_dir": None,          # jax device-trace output (TensorBoard)
+    "aggregate_stats": True,
+    "profile_all": False,
+    "profile_imperative": True,
+}
+_state = {"running": False, "paused": False, "jax_trace": False}
+_agg = {}  # op name -> [count, total_s, min_s, max_s]
+
+
+def set_config(**kwargs):
+    """Parity: profiler.set_config(filename=..., profile_all=...,
+    aggregate_stats=...). Extra TPU-native knob: trace_dir=<dir> enables
+    the jax/XLA device trace (viewable in TensorBoard/perfetto)."""
+    unknown = set(kwargs) - {"filename", "trace_dir", "aggregate_stats",
+                             "profile_all", "profile_imperative",
+                             "profile_symbolic", "profile_memory",
+                             "profile_api", "continuous_dump"}
+    if unknown:
+        raise MXNetError(f"unknown profiler config keys {sorted(unknown)}")
+    for k in ("profile_symbolic", "profile_memory", "profile_api",
+              "continuous_dump"):
+        kwargs.pop(k, None)  # accepted for parity; subsumed by the device trace
+    _config.update(kwargs)
+
+
+def set_state(state_name="stop"):
+    """'run' starts collection (and the jax device trace when trace_dir is
+    configured); 'stop' ends it. Parity: profiler.set_state."""
+    if state_name not in ("run", "stop"):
+        raise MXNetError(f"profiler state must be run|stop, got "
+                         f"{state_name!r}")
+    if state_name == "run" and not _state["running"]:
+        _state["running"], _state["paused"] = True, False
+        with _lock:
+            _agg.clear()
+        if _config["trace_dir"]:
+            jax.profiler.start_trace(_config["trace_dir"])
+            _state["jax_trace"] = True
+    elif state_name == "stop" and _state["running"]:
+        _state["running"] = False
+        if _state["jax_trace"]:
+            jax.profiler.stop_trace()
+            _state["jax_trace"] = False
+
+
+def state():
+    return "run" if _state["running"] else "stop"
+
+
+def pause():
+    _state["paused"] = True
+
+
+def resume():
+    _state["paused"] = False
+
+
+def is_active():
+    return _state["running"] and not _state["paused"]
+
+
+def record_op(name, seconds):
+    """Called from the op dispatch funnel (ops/registry.apply_op)."""
+    with _lock:
+        ent = _agg.get(name)
+        if ent is None:
+            _agg[name] = [1, seconds, seconds, seconds]
+        else:
+            ent[0] += 1
+            ent[1] += seconds
+            ent[2] = min(ent[2], seconds)
+            ent[3] = max(ent[3], seconds)
+
+
+def dumps(reset=False, format="table"):
+    """The aggregate per-op stats table (parity:
+    MXAggregateProfileStatsPrint / profiler.dumps)."""
+    with _lock:
+        items = sorted(_agg.items(), key=lambda kv: -kv[1][1])
+        if reset:
+            _agg.clear()
+    if format == "json":
+        return json.dumps({k: {"count": c, "total_ms": t * 1e3,
+                               "min_ms": mn * 1e3, "max_ms": mx * 1e3}
+                           for k, (c, t, mn, mx) in items})
+    header = (f"{'Name':<40}{'Total Count':>12}{'Time (ms)':>14}"
+              f"{'Min (ms)':>12}{'Max (ms)':>12}{'Avg (ms)':>12}")
+    lines = ["Profile Statistics:", header, "-" * len(header)]
+    for name, (c, t, mn, mx) in items:
+        lines.append(f"{name[:39]:<40}{c:>12}{t * 1e3:>14.3f}"
+                     f"{mn * 1e3:>12.3f}{mx * 1e3:>12.3f}"
+                     f"{t / c * 1e3:>12.3f}")
+    return "\n".join(lines)
+
+
+def dump(finished=True):
+    """Write a chrome://tracing JSON of the aggregate events to
+    config.filename (parity: profiler.dump)."""
+    with _lock:
+        items = list(_agg.items())
+    events = []
+    ts = 0.0
+    for name, (c, t, mn, mx) in items:
+        events.append({"name": name, "ph": "X", "ts": ts * 1e6,
+                       "dur": t * 1e6, "pid": 0, "tid": 0,
+                       "args": {"count": c}})
+        ts += t
+    with open(_config["filename"], "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return _config["filename"]
+
+
+def server_trace_dir():
+    return _config["trace_dir"]
+
+
+class Scope:
+    """Named range: annotates the jax device trace and accrues into the
+    aggregate table (parity: profiler.Scope / ProfileTask)."""
+
+    def __init__(self, name="<unk>"):
+        self._name = name
+        self._ann = None
+        self._t0 = None
+
+    def __enter__(self):
+        self._ann = jax.profiler.TraceAnnotation(self._name)
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        self._ann.__exit__(*exc)
+        if is_active():
+            record_op(f"scope::{self._name}", dt)
+        return False
+
+
+scope = Scope
+
+
+class Task(Scope):
+    """Parity: profiler.Task — start()/stop() object form."""
+
+    def __init__(self, name="<unk>", domain=None):
+        super().__init__(name)
+
+    def start(self):
+        self.__enter__()
+
+    def stop(self):
+        self.__exit__(None, None, None)
+
+
+class Event(Task):
+    pass
+
+
+class Counter:
+    """Parity: profiler.Counter — named monotonic counter recorded into
+    the aggregate table."""
+
+    def __init__(self, name, domain=None, value=0):
+        self._name = name
+        self.value = value
+
+    def set_value(self, v):
+        self.value = v
+        if is_active():
+            record_op(f"counter::{self._name}", 0.0)
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)

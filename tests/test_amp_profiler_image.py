@@ -1,0 +1,208 @@
+"""mx.amp / mx.profiler / mx.image tests (parity: tests/python/unittest/
+test_amp.py, test_profiler.py, test_image.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+# ---------------------------------------------------------------------------
+# amp
+# ---------------------------------------------------------------------------
+
+def _tiny_net():
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4))
+    net.add(nn.BatchNorm(in_channels=8))
+    net.add(nn.Dense(2, in_units=8))
+    net.initialize()
+    return net
+
+
+def test_amp_init_and_convert_model():
+    mx.amp.init(target_dtype="bfloat16")
+    net = _tiny_net()
+    mx.amp.convert_model(net)
+    params = net.collect_params()
+    dense_w = [p for k, p in params.items() if k.endswith("weight")
+               and p.shape is not None and len(p.shape) == 2]
+    assert all(str(p.data().dtype) == "bfloat16" for p in dense_w)
+    # norm params stay f32 (the FP32_FUNCS layer list)
+    bn_gamma = [p for k, p in params.items() if "gamma" in k]
+    assert all(str(p.data().dtype) == "float32" for p in bn_gamma)
+
+
+def test_amp_fp16_loss_scaling_trains_and_handles_overflow():
+    from mxnet_tpu.gluon import Trainer, nn
+
+    mx.amp.init(target_dtype="float16")
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                 kvstore=None)
+    mx.amp.init_trainer(tr)
+    scaler = tr._amp_loss_scaler
+    assert scaler.loss_scale > 1.0
+
+    x = mx.nd.array([[1.0, 2.0]])
+    w0 = net.weight.data().asnumpy().copy()
+    with mx.autograd.record():
+        loss = (net(x) ** 2).sum()
+        with mx.amp.scale_loss(loss, tr) as scaled:
+            mx.autograd.backward(scaled)
+    tr.step(1)
+    w1 = net.weight.data().asnumpy()
+    assert not np.allclose(w0, w1)  # a real (unscaled) update happened
+    # grad magnitude must be the UNSCALED one: compare vs no-amp reference
+    net2 = nn.Dense(1, in_units=2)
+    net2.initialize()
+    net2.weight.set_data(mx.nd.array(w0))
+    net2.bias.set_data(mx.nd.zeros((1,)))
+    tr2 = Trainer(net2.collect_params(), "sgd", {"learning_rate": 0.1},
+                  kvstore=None)
+    with mx.autograd.record():
+        loss2 = (net2(x) ** 2).sum()
+    loss2.backward()
+    tr2.step(1)
+    np.testing.assert_allclose(w1, net2.weight.data().asnumpy(), rtol=1e-3)
+
+    # overflow: inf grads → update skipped, scale halved
+    before = scaler.loss_scale
+    wpre = net.weight.data().asnumpy().copy()
+    with mx.autograd.record():
+        loss = (net(x) * np.inf).sum()
+        with mx.amp.scale_loss(loss, tr) as scaled:
+            mx.autograd.backward(scaled)
+    tr.step(1)
+    assert scaler.loss_scale == before / 2
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), wpre)
+
+
+def test_amp_requires_init_trainer():
+    from mxnet_tpu.gluon import Trainer, nn
+
+    mx.amp.init()
+    net = nn.Dense(1, in_units=1)
+    net.initialize()
+    tr = Trainer(net.collect_params(), "sgd", kvstore=None)
+    with pytest.raises(MXNetError, match="init_trainer"):
+        with mx.amp.scale_loss(mx.nd.array([1.0]), tr):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# profiler
+# ---------------------------------------------------------------------------
+
+def test_profiler_aggregate_stats(tmp_path):
+    mx.profiler.set_config(filename=str(tmp_path / "prof.json"),
+                           aggregate_stats=True)
+    mx.profiler.set_state("run")
+    try:
+        a = mx.nd.array([1.0, 2.0])
+        with mx.profiler.scope("my_region"):
+            (a * 2 + 1).sum().asscalar()
+    finally:
+        mx.profiler.set_state("stop")
+    table = mx.profiler.dumps()
+    assert "Profile Statistics" in table
+    assert "scope::my_region" in table
+    stats = mx.profiler.dumps(format="json")
+    import json
+    parsed = json.loads(stats)
+    assert any(k != "scope::my_region" for k in parsed)  # op rows recorded
+    path = mx.profiler.dump()
+    trace = json.loads(open(path).read())
+    assert trace["traceEvents"], "chrome trace must contain events"
+    assert mx.profiler.state() == "stop"
+
+
+def test_profiler_pause_resume():
+    mx.profiler.set_state("run")
+    try:
+        mx.profiler.pause()
+        mx.nd.array([1.0]).sum().asscalar()
+        paused_stats = mx.profiler.dumps(format="json")
+        mx.profiler.resume()
+        mx.nd.array([1.0]).sum().asscalar()
+    finally:
+        mx.profiler.set_state("stop")
+    import json
+    assert json.loads(paused_stats) == {}
+
+
+def test_profiler_rejects_bad_config():
+    with pytest.raises(MXNetError):
+        mx.profiler.set_config(bogus_key=1)
+    with pytest.raises(MXNetError):
+        mx.profiler.set_state("bogus")
+
+
+# ---------------------------------------------------------------------------
+# image
+# ---------------------------------------------------------------------------
+
+def _png_bytes(h=8, w=6):
+    import cv2
+    img = np.arange(h * w * 3, dtype=np.uint8).reshape(h, w, 3)
+    ok, buf = cv2.imencode(".png", img)
+    assert ok
+    return img, bytes(buf.tobytes())
+
+
+def test_imdecode_imresize_roundtrip():
+    bgr, buf = _png_bytes()
+    img = mx.image.imdecode(buf)
+    assert img.shape == (8, 6, 3)
+    # reference semantics: decode is RGB (cv2 file order is BGR)
+    np.testing.assert_array_equal(img.asnumpy(), bgr[..., ::-1])
+    small = mx.image.imresize(img, 3, 4)
+    assert small.shape == (4, 3, 3)
+    short = mx.image.resize_short(img, 4)
+    assert min(short.shape[:2]) == 4
+
+
+def test_imread_and_crops(tmp_path):
+    import cv2
+    img = np.random.default_rng(0).integers(
+        0, 255, (16, 12, 3)).astype(np.uint8)
+    path = str(tmp_path / "t.png")
+    cv2.imwrite(path, img)
+    loaded = mx.image.imread(path)
+    np.testing.assert_array_equal(loaded.asnumpy(), img[..., ::-1])
+    c, rect = mx.image.center_crop(loaded, (8, 8))
+    assert c.shape == (8, 8, 3) and rect == (2, 4, 8, 8)
+    r, rect = mx.image.random_crop(loaded, (6, 6))
+    assert r.shape == (6, 6, 3)
+    f = mx.image.fixed_crop(loaded, 1, 2, 5, 6)
+    np.testing.assert_array_equal(f.asnumpy(),
+                                  loaded.asnumpy()[2:8, 1:6])
+
+
+def test_to_tensor_normalize():
+    img = mx.nd.array(np.full((4, 5, 3), 255, np.uint8), dtype="uint8")
+    t = mx.image.to_tensor(img)
+    assert t.shape == (3, 4, 5)
+    np.testing.assert_allclose(t.asnumpy(), 1.0)
+    n = mx.image.normalize(t, mean=(1.0, 1.0, 1.0), std=(2.0, 2.0, 2.0))
+    np.testing.assert_allclose(n.asnumpy(), 0.0)
+
+
+def test_augmenter_pipeline():
+    img = mx.nd.array(np.random.default_rng(1).integers(
+        0, 255, (40, 30, 3)), dtype="uint8")
+    augs = mx.image.CreateAugmenter(data_shape=(3, 24, 24), resize=26,
+                                    rand_crop=True, rand_mirror=True,
+                                    brightness=0.1, contrast=0.1,
+                                    saturation=0.1, pca_noise=0.05,
+                                    mean=np.zeros(3, np.float32),
+                                    std=np.ones(3, np.float32))
+    out = img
+    for aug in augs:
+        out = aug(out)
+    assert out.shape == (24, 24, 3)
+    assert str(out.dtype) == "float32"
+    assert augs[0].dumps()  # serializable descriptions

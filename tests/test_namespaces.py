@@ -8,12 +8,11 @@ from mxnet_tpu.base import MXNetError
 
 
 def test_advertised_namespaces_import():
-    # amp/profiler/image are implemented later this round; the rest must
-    # never regress to ModuleNotFoundError
+    # EVERY advertised lazy-map name must import (no phantom namespaces)
     for name in ("np", "npx", "gluon", "optimizer", "metric", "initializer",
                  "init", "lr_scheduler", "kv", "kvstore", "parallel", "io",
                  "recordio", "test_utils", "runtime", "engine", "context",
-                 "functional", "models"):
+                 "functional", "models", "amp", "profiler", "image"):
         mod = getattr(mx, name)
         assert mod is not None, name
 
