@@ -7,20 +7,25 @@ from ...base import MXNetError
 # import *` rebinds the name `alexnet` to the entry-point function
 from . import alexnet as _alexnet
 from . import densenet as _densenet
+from . import inception as _inception
 from . import mobilenet as _mobilenet
 from . import resnet as _resnet
 from . import squeezenet as _squeezenet
+from . import ssd as _ssd
 from . import vgg as _vgg
 
 from .alexnet import *  # noqa: F401,F403,E402
 from .densenet import *  # noqa: F401,F403,E402
+from .inception import *  # noqa: F401,F403,E402
 from .mobilenet import *  # noqa: F401,F403,E402
 from .resnet import *  # noqa: F401,F403,E402
 from .squeezenet import *  # noqa: F401,F403,E402
+from .ssd import *  # noqa: F401,F403,E402
 from .vgg import *  # noqa: F401,F403,E402
 
 _models = {}
-for _mod in (_alexnet, _densenet, _mobilenet, _resnet, _squeezenet, _vgg):
+for _mod in (_alexnet, _densenet, _inception, _mobilenet, _resnet,
+             _squeezenet, _ssd, _vgg):
     for _name in _mod.__all__:
         _obj = getattr(_mod, _name)
         if callable(_obj) and _name[0].islower() and not \

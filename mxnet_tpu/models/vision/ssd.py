@@ -1,0 +1,191 @@
+"""SSD single-shot detector (the SSD-512 target workload).
+
+Reference parity: GluonCV's model_zoo/ssd (ssd_512_resnet50_v1_voc — the
+BASELINE.md mAP 80.1 workload) built on the reference's multibox ops
+(src/operator/contrib/multibox_{prior,target,detection}.cc) — here the
+padded fixed-K ops in mxnet_tpu/ops/detection.py, so the WHOLE detector
+(backbone, heads, anchor decode, NMS) jits into one static-shape XLA
+program; no dynamic-size outputs anywhere (SURVEY.md §7.3.2).
+
+Structure: ResNet-50 v1b stages 3+4 as the first two scales, then extra
+conv blocks halving resolution, one (cls, box) conv head pair per scale.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ...gluon.block import HybridBlock
+from ...gluon.loss import Loss, SoftmaxCrossEntropyLoss
+from ...gluon.nn import BatchNorm, Conv2D, HybridSequential
+from ...ndarray.ndarray import NDArray
+from ...ops import detection as _det, nn as _opnn, tensor as _opt
+from .resnet import resnet50_v1b
+
+__all__ = ["SSD", "SSDMultiBoxLoss", "ssd_512_resnet50_v1",
+           "ssd_512_resnet50_v1_voc"]
+
+
+class _ExtraBlock(HybridBlock):
+    """1x1 squeeze + 3x3/2 expand (the SSD extra-layer recipe)."""
+
+    def __init__(self, squeeze, expand, **kwargs):
+        super().__init__(**kwargs)
+        self.body = HybridSequential()
+        self.body.add(Conv2D(squeeze, kernel_size=1, use_bias=False))
+        self.body.add(BatchNorm())
+        self.body.add(Conv2D(expand, kernel_size=3, strides=2, padding=1,
+                             use_bias=False))
+        self.body.add(BatchNorm())
+
+    def forward(self, x):
+        return _opnn.Activation(self.body(x), act_type="relu")
+
+
+class SSD(HybridBlock):
+    """Generic SSD over a resnet50_v1b backbone.
+
+    forward(x) -> (cls_preds (B, N, C+1), box_preds (B, N*4),
+    anchors (1, N, 4)); N is static given the input size. Use
+    multibox_target on the anchors for training and
+    SSD.detect()/multibox_detection for inference.
+    """
+
+    def __init__(self, classes, image_size=512, num_extras=3,
+                 sizes=None, ratios=None, **kwargs):
+        super().__init__(**kwargs)
+        self._classes = classes
+        self._image_size = image_size
+        base = resnet50_v1b(classes=10)
+        feats = list(base.features._children.values())
+        # stem + stage1..3 → stride 16 (1024 ch); stage 4 → stride 32
+        self.stage3 = HybridSequential()
+        for f in feats[:7]:
+            self.stage3.add(f)
+        self.stage4 = feats[7]
+        self.extras = HybridSequential()
+        for _ in range(num_extras):
+            self.extras.add(_ExtraBlock(256, 512))
+        n_scales = 2 + num_extras
+        if sizes is None:
+            # GluonCV recipe: linear size ramp over scales (fractions)
+            lo, hi = 0.1, 0.95
+            s = _np.linspace(lo, hi, n_scales + 1)
+            sizes = [(s[i], float(_np.sqrt(s[i] * s[i + 1])))
+                     for i in range(n_scales)]
+        if ratios is None:
+            ratios = [(1.0, 2.0, 0.5)] * 2 + \
+                [(1.0, 2.0, 0.5, 3.0, 1.0 / 3)] * (n_scales - 2)
+        if len(sizes) != n_scales or len(ratios) != n_scales:
+            raise MXNetError(
+                f"need {n_scales} sizes/ratios, got {len(sizes)}/"
+                f"{len(ratios)}")
+        self._sizes = sizes
+        self._ratios = ratios
+        self.cls_heads = HybridSequential()
+        self.box_heads = HybridSequential()
+        for sz, rt in zip(sizes, ratios):
+            A = len(sz) + len(rt) - 1
+            self.cls_heads.add(Conv2D(A * (classes + 1), kernel_size=3,
+                                      padding=1))
+            self.box_heads.add(Conv2D(A * 4, kernel_size=3, padding=1))
+
+    @property
+    def num_classes(self):
+        return self._classes
+
+    def forward(self, x):
+        feats = []
+        y = self.stage3(x)
+        feats.append(y)
+        y = self.stage4(y)
+        feats.append(y)
+        for blk in self.extras._children.values():
+            y = blk(y)
+            feats.append(y)
+        cls_preds, box_preds, anchors = [], [], []
+        heads = zip(feats, self.cls_heads._children.values(),
+                    self.box_heads._children.values(),
+                    self._sizes, self._ratios)
+        B = x.shape[0]
+        for feat, ch, bh, sz, rt in heads:
+            cp = ch(feat)   # (B, A*(C+1), H, W)
+            bp = bh(feat)   # (B, A*4, H, W)
+            cls_preds.append(cp.transpose((0, 2, 3, 1)).reshape(
+                (B, -1, self._classes + 1)))
+            box_preds.append(bp.transpose((0, 2, 3, 1)).reshape((B, -1)))
+            anchors.append(_det.multibox_prior(feat, sizes=sz, ratios=rt,
+                                               clip=True))
+        cls_pred = _opt.concat(*cls_preds, dim=1)
+        box_pred = _opt.concat(*box_preds, dim=1)
+        anchor = _opt.concat(*anchors, dim=1)
+        return cls_pred, box_pred, anchor
+
+    def detect(self, x, nms_threshold=0.45, threshold=0.01, nms_topk=400):
+        """End-to-end inference: forward + softmax + decode + NMS →
+        (B, N, 6) rows [class_id, score, x1, y1, x2, y2] (invalid -1)."""
+        cls_pred, box_pred, anchor = self(x)
+        probs = _opnn.softmax(cls_pred, axis=-1).transpose((0, 2, 1))
+        return _det.multibox_detection(
+            probs, box_pred, anchor, nms_threshold=nms_threshold,
+            threshold=threshold, nms_topk=nms_topk)
+
+
+class SSDMultiBoxLoss(Loss):
+    """Cls cross-entropy with 3:1 hard negative mining + smooth-L1 box
+    loss (parity: GluonCV SSDMultiBoxLoss)."""
+
+    def __init__(self, negative_mining_ratio=3.0, rho=1.0, lambd=1.0,
+                 **kwargs):
+        super().__init__(None, 0, **kwargs)
+        self._ratio = negative_mining_ratio
+        self._rho = rho
+        self._lambd = lambd
+
+    def forward(self, cls_pred, box_pred, cls_target, box_target,
+                box_mask):
+        from ...ops.registry import apply_op
+        rho, ratio, lambd = self._rho, self._ratio, self._lambd
+
+        def closed(cp, bp, ct, bt, bm):
+            B, N, C1 = cp.shape
+            lsm = -_jax_log_softmax(cp)                   # (B, N, C+1)
+            ct_i = ct.astype("int32")
+            ce = jnp.take_along_axis(lsm, ct_i[..., None], axis=-1)[..., 0]
+            pos = ct > 0
+            n_pos = jnp.maximum(pos.sum(axis=1), 1)
+            # hard negative mining: top (ratio * n_pos) background losses
+            neg_ce = jnp.where(pos, -jnp.inf, lsm[..., 0])
+            rank = jnp.argsort(jnp.argsort(-neg_ce, axis=1), axis=1)
+            neg = rank < (ratio * n_pos)[:, None]
+            cls_loss = jnp.where(pos | neg, ce, 0.0).sum(axis=1) / n_pos
+            diff = jnp.abs((bp - bt) * bm).reshape(B, -1)
+            sl1 = jnp.where(diff > rho, diff - 0.5 * rho,
+                            0.5 / rho * diff * diff)
+            box_loss = sl1.sum(axis=1) / n_pos
+            return cls_loss + lambd * box_loss
+
+        return apply_op("SSDMultiBoxLoss", closed,
+                        [cls_pred, box_pred, cls_target, box_target,
+                         box_mask])
+
+
+def _jax_log_softmax(x):
+    import jax
+    return jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+
+
+def ssd_512_resnet50_v1(classes=20, pretrained=False, **kwargs):
+    """SSD-512 with ResNet-50 v1b (parity: GluonCV
+    ssd_512_resnet50_v1_voc, BASELINE.md mAP 80.1 row)."""
+    if pretrained:
+        raise MXNetError("pretrained weights unavailable (no network "
+                         "egress); train from scratch or load_parameters")
+    return SSD(classes=classes, image_size=512, **kwargs)
+
+
+def ssd_512_resnet50_v1_voc(**kwargs):
+    kwargs.setdefault("classes", 20)
+    return ssd_512_resnet50_v1(**kwargs)

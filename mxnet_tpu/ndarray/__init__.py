@@ -13,6 +13,9 @@ from ..ops.init import (  # noqa: F401
 )
 from ..ops import math, tensor, nn, init  # noqa: F401
 from ..ops import random  # noqa: F401
+from ..ops.detection import (  # noqa: F401
+    box_iou, box_nms, multibox_detection, multibox_prior, multibox_target,
+    roi_align)
 from . import contrib  # noqa: F401
 from ..ops.registry import OPS
 

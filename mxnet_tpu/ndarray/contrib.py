@@ -1,6 +1,10 @@
 """mx.nd.contrib namespace.
 
 Reference parity: python/mxnet/ndarray/contrib.py — the python wrappers
-over src/operator/control_flow.cc's foreach/while_loop/cond.
+over src/operator/control_flow.cc's foreach/while_loop/cond, plus the
+contrib detection ops (multibox_*, box_nms) the reference exposes here.
 """
 from ..ops.control_flow import cond, foreach, while_loop  # noqa: F401
+from ..ops.detection import (  # noqa: F401
+    box_iou, box_nms, multibox_detection, multibox_prior, multibox_target,
+    roi_align)

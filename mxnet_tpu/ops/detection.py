@@ -1,0 +1,316 @@
+"""Detection / CV ops: IoU, NMS, SSD multibox ops, ROI align.
+
+Reference parity: src/operator/contrib/bounding_box.cc (`box_iou`,
+`box_nms`), src/operator/contrib/multibox_prior.cc / multibox_target.cc /
+multibox_detection.cc (the SSD-512 dependency set), and
+src/operator/contrib/roi_align.cc (SURVEY.md §2.3 'Detection / CV ops').
+
+TPU-native design (SURVEY.md §7.3.2): NMS's data-dependent output count is
+the classic dynamic-shape hazard — every op here is the PADDED FIXED-K
+formulation: shapes never depend on data; suppressed/invalid entries are
+marked with -1 exactly as the reference's kernels mark them, and the
+suppression loop is a lax.fori_loop over the static box count, so the
+whole post-processing pipeline jits into the model program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import op
+
+__all__ = ["box_iou", "box_nms", "multibox_prior", "multibox_target",
+           "multibox_detection", "roi_align"]
+
+
+def _to_corner(b, fmt):
+    if fmt == "corner":
+        return b
+    if fmt == "center":  # (cx, cy, w, h) → (x1, y1, x2, y2)
+        cx, cy, w, h = jnp.split(b, 4, axis=-1)
+        return jnp.concatenate(
+            [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    raise MXNetError(f"unknown box format {fmt!r}")
+
+
+def _iou_corner(a, b):
+    """a: (..., N, 4), b: (..., M, 4) corner boxes → (..., N, M) IoU."""
+    a = a[..., :, None, :]
+    b = b[..., None, :, :]
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:], b[..., 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area = lambda x: jnp.maximum(x[..., 2] - x[..., 0], 0.0) * \
+        jnp.maximum(x[..., 3] - x[..., 1], 0.0)  # noqa: E731
+    union = area(a) + area(b) - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@op("box_iou")
+def box_iou(lhs, rhs, format="corner"):
+    """Parity: bounding_box.cc box_iou. lhs (..., N, 4), rhs (..., M, 4)
+    → (..., N, M)."""
+    return _iou_corner(_to_corner(lhs, format), _to_corner(rhs, format))
+
+
+def _nms_single(boxes, scores, ids, valid, overlap_thresh, force_suppress):
+    """Greedy NMS keep-mask over N static boxes (score-descending order).
+    All inputs are per-image 1D/2D arrays; returns keep mask (N,) bool."""
+    N = scores.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    s_ids = ids[order]
+    s_valid = valid[order]
+    iou = _iou_corner(b, b)                       # (N, N)
+    same_cls = (s_ids[:, None] == s_ids[None, :]) | force_suppress
+
+    def body(i, keep):
+        # suppress any lower-ranked box overlapping a kept box i
+        sup = (iou[i] > overlap_thresh) & same_cls[i] & keep[i] & s_valid[i]
+        sup = sup & (jnp.arange(N) > i)
+        return keep & ~sup
+
+    keep_sorted = lax.fori_loop(0, N, body, s_valid)
+    # unsort back to input order
+    keep = jnp.zeros((N,), bool).at[order].set(keep_sorted)
+    return keep
+
+
+@op("box_nms")
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1,
+            background_id=-1, force_suppress=False, in_format="corner",
+            out_format="corner"):
+    """Parity: bounding_box.cc box_nms. data (B, N, K) rows
+    [.., score, .., x1, y1, x2, y2, ..]; returns the same shape with
+    suppressed/invalid rows set to -1 (the reference's marker), shapes
+    independent of the data (padded fixed-K TPU contract)."""
+    squeeze = data.ndim == 2
+    if squeeze:
+        data = data[None]
+    B, N, K = data.shape
+    scores = data[..., score_index]
+    boxes = _to_corner(
+        lax.dynamic_slice_in_dim(data, coord_start, 4, axis=2), in_format)
+    if id_index >= 0:
+        ids = data[..., id_index]
+        valid = (scores > valid_thresh) & (ids != background_id)
+    else:
+        ids = jnp.zeros_like(scores)
+        valid = scores > valid_thresh
+    if topk > 0:
+        # only the topk highest scores per image stay candidates
+        kth = -jnp.sort(-jnp.where(valid, scores, -jnp.inf), axis=-1)[
+            :, min(topk, N) - 1]
+        valid = valid & (scores >= kth[:, None])
+
+    keep = jax.vmap(
+        lambda b, s, i, v: _nms_single(b, s, i, v, overlap_thresh,
+                                       force_suppress))(
+        boxes, scores, ids, valid)
+    if out_format != in_format:
+        if out_format == "corner":
+            coords = boxes                       # already converted
+        elif out_format == "center":
+            x1, y1, x2, y2 = jnp.split(boxes, 4, axis=-1)
+            coords = jnp.concatenate(
+                [(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], axis=-1)
+        else:
+            raise MXNetError(f"unknown box format {out_format!r}")
+        data = lax.dynamic_update_slice_in_dim(data, coords, coord_start,
+                                               axis=2)
+    out = jnp.where(keep[..., None], data, -jnp.ones_like(data))
+    return out[0] if squeeze else out
+
+
+@op("multibox_prior", nodiff=True)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Parity: multibox_prior.cc — SSD anchor generation. data (B, C, H, W)
+    → (1, H*W*(S+R-1), 4) corner-format anchors in [0, 1] coords.
+    Anchor set per cell: (s_i, r_0) for every size + (s_0, r_j) for every
+    extra ratio (the reference's S+R-1 layout)."""
+    H, W = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H) + offsets[0]) * step_y
+    cx = (jnp.arange(W) + offsets[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")      # (H, W)
+    ws, hs = [], []
+    for i, s in enumerate(sizes):
+        r = ratios[0]
+        sr = jnp.sqrt(r)
+        ws.append(s * sr)
+        hs.append(s / sr)
+    for r in ratios[1:]:
+        sr = jnp.sqrt(r)
+        ws.append(sizes[0] * sr)
+        hs.append(sizes[0] / sr)
+    ws = jnp.asarray(ws)                                 # (A,)
+    hs = jnp.asarray(hs)
+    A = ws.shape[0]
+    cxg = jnp.broadcast_to(cxg[..., None], (H, W, A))
+    cyg = jnp.broadcast_to(cyg[..., None], (H, W, A))
+    anchors = jnp.stack(
+        [cxg - ws / 2, cyg - hs / 2, cxg + ws / 2, cyg + hs / 2], axis=-1)
+    anchors = anchors.reshape(1, H * W * A, 4)
+    if clip:
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return anchors
+
+
+def _center(b):
+    return ((b[..., 0] + b[..., 2]) / 2, (b[..., 1] + b[..., 3]) / 2,
+            b[..., 2] - b[..., 0], b[..., 3] - b[..., 1])
+
+
+@op("multibox_target", nodiff=True)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Parity: multibox_target.cc — SSD anchor↔gt matching + target
+    encoding. anchor (1, N, 4) corner; label (B, M, 5) rows
+    [cls_id, x1, y1, x2, y2] padded with -1; cls_pred (B, C+1, N) (used
+    for shape/negative mining parity). Returns (box_target (B, N*4),
+    box_mask (B, N*4), cls_target (B, N)) — cls_target 0 = background,
+    gt class ids shifted +1, exactly the reference's convention."""
+    N = anchor.shape[1]
+    B, M = label.shape[0], label.shape[1]
+    anc = anchor[0]                                       # (N, 4)
+
+    def one(lbl):
+        gt_valid = lbl[:, 0] >= 0                         # (M,)
+        gt_boxes = lbl[:, 1:5]
+        iou = _iou_corner(anc, gt_boxes)                  # (N, M)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)                 # (N,)
+        best_iou = jnp.max(iou, axis=1)
+        matched = best_iou >= overlap_threshold
+        # bipartite stage: each gt claims its best anchor (the reference
+        # matches greedily; argmax per gt is the standard approximation —
+        # if two VALID gts share a best anchor the later one wins).
+        # Invalid (padding) gts are routed out of range and dropped, so
+        # they can never clobber a valid gt's forced match.
+        best_anchor = jnp.argmax(iou, axis=0)             # (M,)
+        safe_anchor = jnp.where(gt_valid, best_anchor, N)
+        forced = jnp.zeros((N,), bool).at[safe_anchor].set(
+            True, mode="drop")
+        gt_of = best_gt.at[safe_anchor].set(jnp.arange(M), mode="drop")
+        pos = matched | forced
+        g = gt_boxes[gt_of]                               # (N, 4)
+        acx, acy, aw, ah = _center(anc)
+        gcx, gcy, gw, gh = _center(g)
+        eps = 1e-8
+        tx = (gcx - acx) / jnp.maximum(aw, eps) / variances[0]
+        ty = (gcy - acy) / jnp.maximum(ah, eps) / variances[1]
+        tw = jnp.log(jnp.maximum(gw, eps) /
+                     jnp.maximum(aw, eps)) / variances[2]
+        th = jnp.log(jnp.maximum(gh, eps) /
+                     jnp.maximum(ah, eps)) / variances[3]
+        bt = jnp.stack([tx, ty, tw, th], axis=-1)         # (N, 4)
+        bt = jnp.where(pos[:, None], bt, 0.0)
+        bm = jnp.tile(pos[:, None].astype(bt.dtype), (1, 4))
+        ct = jnp.where(pos, lbl[gt_of, 0] + 1.0, 0.0)
+        return bt.reshape(-1), bm.reshape(-1), ct
+
+    bt, bm, ct = jax.vmap(one)(label)
+    return bt, bm, ct
+
+
+@op("multibox_detection")
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                       threshold=0.01, background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Parity: multibox_detection.cc — decode loc predictions against the
+    anchors and run per-class NMS. cls_prob (B, C+1, N) (class 0 =
+    background), loc_pred (B, N*4), anchor (1, N, 4). Returns (B, N, 6)
+    rows [class_id, score, x1, y1, x2, y2], invalid rows -1."""
+    B = cls_prob.shape[0]
+    N = anchor.shape[1]
+    anc = anchor[0]
+    acx, acy, aw, ah = _center(anc)
+    loc = loc_pred.reshape(B, N, 4)
+    cx = loc[..., 0] * variances[0] * aw + acx
+    cy = loc[..., 1] * variances[1] * ah + acy
+    w = jnp.exp(loc[..., 2] * variances[2]) * aw
+    h = jnp.exp(loc[..., 3] * variances[3]) * ah
+    boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                      axis=-1)                            # (B, N, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    # best foreground class per anchor (the reference's per-anchor argmax)
+    fg = cls_prob[:, 1:, :]                               # (B, C, N)
+    cls_id = jnp.argmax(fg, axis=1).astype(jnp.float32)   # (B, N)
+    score = jnp.max(fg, axis=1)
+    valid = score > threshold
+    cls_id = jnp.where(valid, cls_id, -1.0)
+    score = jnp.where(valid, score, -1.0)
+    out = jnp.concatenate(
+        [cls_id[..., None], score[..., None], boxes], axis=-1)
+    return box_nms.raw_fn(out, overlap_thresh=nms_threshold,
+                          valid_thresh=threshold, topk=nms_topk,
+                          coord_start=2, score_index=1, id_index=0,
+                          background_id=-1, force_suppress=force_suppress)
+
+
+@op("roi_align")
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=2, aligned=False):
+    """Parity: contrib/roi_align.cc (Mask R-CNN ROIAlign). data
+    (B, C, H, W); rois (R, 5) rows [batch_idx, x1, y1, x2, y2] in image
+    coords. Returns (R, C, PH, PW). Bilinear sampling at sample_ratio²
+    points per output bin, averaged."""
+    B, C, H, W = data.shape
+    PH, PW = pooled_size
+    sr = max(int(sample_ratio), 1)
+    offset = 0.5 if aligned else 0.0
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale - offset, \
+            roi[2] * spatial_scale - offset, \
+            roi[3] * spatial_scale - offset, \
+            roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bin_w = rw / PW
+        bin_h = rh / PH
+        # sample grid: (PH, sr) × (PW, sr)
+        iy = jnp.arange(PH)[:, None] * bin_h + \
+            (jnp.arange(sr)[None, :] + 0.5) * bin_h / sr + y1
+        ix = jnp.arange(PW)[:, None] * bin_w + \
+            (jnp.arange(sr)[None, :] + 0.5) * bin_w / sr + x1
+        ys = iy.reshape(-1)                                # (PH*sr,)
+        xs = ix.reshape(-1)                                # (PW*sr,)
+        img = data[bidx]                                   # (C, H, W)
+
+        y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+        y1i = jnp.clip(y0 + 1, 0, H - 1)
+        x1i = jnp.clip(x0 + 1, 0, W - 1)
+        ly = jnp.clip(ys - y0, 0.0, 1.0)
+        lx = jnp.clip(xs - x0, 0.0, 1.0)
+        y0 = y0.astype(jnp.int32)
+        x0 = x0.astype(jnp.int32)
+        y1i = y1i.astype(jnp.int32)
+        x1i = x1i.astype(jnp.int32)
+
+        def gather(yy, xx):
+            # (C, PH*sr, PW*sr)
+            return img[:, yy[:, None], xx[None, :]]
+
+        v = (gather(y0, x0) * ((1 - ly)[:, None] * (1 - lx)[None, :]) +
+             gather(y0, x1i) * ((1 - ly)[:, None] * lx[None, :]) +
+             gather(y1i, x0) * (ly[:, None] * (1 - lx)[None, :]) +
+             gather(y1i, x1i) * (ly[:, None] * lx[None, :]))
+        v = v.reshape(C, PH, sr, PW, sr).mean(axis=(2, 4))
+        # rois outside the image / sampling beyond borders are clamped —
+        # matching the reference's boundary handling
+        return v
+
+    return jax.vmap(one)(rois)

@@ -1,0 +1,253 @@
+"""Detection ops + SSD tests, numpy-reference oracles (parity targets:
+src/operator/contrib/bounding_box.cc, multibox_*.cc, roi_align.cc and the
+GluonCV SSD-512; SURVEY.md §2.3 detection row)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def _np_iou(a, b):
+    tl = np.maximum(a[:, None, :2], b[None, :, :2])
+    br = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(br - tl, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area = lambda x: np.clip(x[:, 2] - x[:, 0], 0, None) * \
+        np.clip(x[:, 3] - x[:, 1], 0, None)  # noqa: E731
+    union = area(a)[:, None] + area(b)[None, :] - inter
+    return np.where(union > 0, inter / np.where(union > 0, union, 1.0), 0.0)
+
+
+def test_box_iou_matches_numpy():
+    r = np.random.default_rng(0)
+    a = np.sort(r.random((5, 2, 2)), axis=1).reshape(5, 4)[:, [0, 2, 1, 3]]
+    b = np.sort(r.random((7, 2, 2)), axis=1).reshape(7, 4)[:, [0, 2, 1, 3]]
+    got = mx.nd.box_iou(mx.nd.array(a), mx.nd.array(b)).asnumpy()
+    np.testing.assert_allclose(got, _np_iou(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_box_iou_center_format():
+    a_center = np.array([[0.5, 0.5, 1.0, 1.0]])  # == corner (0,0,1,1)
+    b_corner = np.array([[0.0, 0.0, 1.0, 1.0]])
+    got = mx.nd.box_iou(mx.nd.array(a_center), mx.nd.array(b_corner),
+                        format="center")
+    # only lhs/rhs both in 'center' — convert b to center too
+    b_center = np.array([[0.5, 0.5, 1.0, 1.0]])
+    got = mx.nd.box_iou(mx.nd.array(a_center), mx.nd.array(b_center),
+                        format="center").asnumpy()
+    np.testing.assert_allclose(got, [[1.0]], rtol=1e-6)
+
+
+def _np_greedy_nms(dets, thresh, valid_thresh):
+    """Reference greedy NMS: rows [id, score, x1, y1, x2, y2]."""
+    keep = []
+    idx = np.argsort(-dets[:, 1])
+    alive = [i for i in idx if dets[i, 1] > valid_thresh]
+    while alive:
+        i = alive.pop(0)
+        keep.append(i)
+        rest = []
+        for j in alive:
+            if dets[i, 0] == dets[j, 0]:
+                iou = _np_iou(dets[i:i + 1, 2:6], dets[j:j + 1, 2:6])[0, 0]
+                if iou > thresh:
+                    continue
+            rest.append(j)
+        alive = rest
+    return sorted(keep)
+
+
+def test_box_nms_matches_reference_greedy():
+    r = np.random.default_rng(1)
+    N = 12
+    xy1 = r.random((N, 2))
+    wh = r.random((N, 2)) * 0.4 + 0.05
+    dets = np.concatenate([
+        r.integers(0, 2, (N, 1)).astype(float),    # class id
+        r.random((N, 1)),                          # score
+        xy1, xy1 + wh], axis=1).astype(np.float32)
+    out = mx.nd.box_nms(mx.nd.array(dets), overlap_thresh=0.5,
+                        valid_thresh=0.1, coord_start=2, score_index=1,
+                        id_index=0).asnumpy()
+    keep_ref = _np_greedy_nms(dets, 0.5, 0.1)
+    kept = sorted(np.nonzero(out[:, 1] >= 0)[0].tolist())
+    assert kept == keep_ref
+    # kept rows unchanged, suppressed rows fully -1 (reference marker)
+    np.testing.assert_allclose(out[kept], dets[kept], rtol=1e-6)
+    sup = [i for i in range(N) if i not in kept]
+    np.testing.assert_array_equal(out[sup], -1.0)
+    # shape is data-independent (padded fixed-K contract)
+    assert out.shape == dets.shape
+
+
+def test_box_nms_force_suppress_and_topk():
+    dets = np.array([
+        [0, 0.9, 0.0, 0.0, 1.0, 1.0],
+        [1, 0.8, 0.0, 0.0, 1.0, 1.0],   # other class, same box
+        [0, 0.7, 0.5, 0.5, 0.6, 0.6],
+    ], np.float32)
+    out = mx.nd.box_nms(mx.nd.array(dets), overlap_thresh=0.5,
+                        id_index=0).asnumpy()
+    assert (out[1, 1] >= 0)  # different class survives
+    out = mx.nd.box_nms(mx.nd.array(dets), overlap_thresh=0.5, id_index=0,
+                        force_suppress=True).asnumpy()
+    assert out[1, 0] == -1  # force_suppress kills it
+    out = mx.nd.box_nms(mx.nd.array(dets), overlap_thresh=0.5, id_index=0,
+                        topk=1).asnumpy()
+    assert (out[2] == -1).all()  # below topk cut
+
+
+def test_multibox_prior_layout():
+    x = mx.nd.zeros((1, 8, 4, 6))
+    anchors = mx.nd.multibox_prior(x, sizes=(0.5, 0.25),
+                                   ratios=(1.0, 2.0)).asnumpy()
+    # S + R - 1 = 3 anchors per cell
+    assert anchors.shape == (1, 4 * 6 * 3, 4)
+    # first cell center is (0.5/W, 0.5/H); first anchor is size .5 ratio 1
+    cx = (anchors[0, 0, 0] + anchors[0, 0, 2]) / 2
+    cy = (anchors[0, 0, 1] + anchors[0, 0, 3]) / 2
+    np.testing.assert_allclose([cx, cy], [0.5 / 6, 0.5 / 4], rtol=1e-5)
+    np.testing.assert_allclose(anchors[0, 0, 2] - anchors[0, 0, 0], 0.5,
+                               rtol=1e-5)
+    clipped = mx.nd.multibox_prior(x, sizes=(0.9,), ratios=(1.0,),
+                                   clip=True).asnumpy()
+    assert clipped.min() >= 0 and clipped.max() <= 1
+
+
+def test_multibox_target_matching_and_encoding():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.5, 0.5, 1.0]]], np.float32)
+    # one gt overlapping anchor 0 strongly; class id 2
+    label = np.array([[[2.0, 0.05, 0.05, 0.45, 0.45],
+                       [-1, -1, -1, -1, -1]]], np.float32)
+    cls_pred = np.zeros((1, 4, 3), np.float32)
+    bt, bm, ct = mx.nd.multibox_target(
+        mx.nd.array(anchors), mx.nd.array(label), mx.nd.array(cls_pred))
+    ct = ct.asnumpy()
+    bm = bm.asnumpy().reshape(1, 3, 4)
+    bt = bt.asnumpy().reshape(1, 3, 4)
+    assert ct[0, 0] == 3.0          # class id + 1 (0 = background)
+    assert ct[0, 1] == 0.0 and ct[0, 2] == 0.0
+    np.testing.assert_array_equal(bm[0, 0], 1.0)
+    np.testing.assert_array_equal(bm[0, 1:], 0.0)
+    # encoding: gt center == (0.25, 0.25) == anchor center → tx=ty=0;
+    # gt w/h 0.4 vs anchor 0.5 → tw = log(0.8)/0.2
+    np.testing.assert_allclose(bt[0, 0, :2], 0.0, atol=1e-5)
+    np.testing.assert_allclose(bt[0, 0, 2:], np.log(0.8) / 0.2, rtol=1e-4)
+
+
+def test_box_nms_out_format_conversion():
+    dets = np.array([[0, 0.9, 0.2, 0.2, 0.6, 0.8]], np.float32)
+    out = mx.nd.box_nms(mx.nd.array(dets), id_index=0,
+                        in_format="corner", out_format="center").asnumpy()
+    np.testing.assert_allclose(out[0, 2:], [0.4, 0.5, 0.4, 0.6],
+                               rtol=1e-5)
+
+
+def test_multibox_target_padding_rows_cannot_clobber():
+    """Invalid (padding) gt rows must not erase a valid gt's forced match
+    on anchor 0 (review regression: duplicate-index scatter collision)."""
+    anchors = np.array([[[0.0, 0.0, 0.2, 0.2],
+                         [0.6, 0.6, 1.0, 1.0]]], np.float32)
+    # valid gt's best anchor is 0 (low IoU → forced); then padding rows
+    label = np.array([[[4.0, 0.0, 0.0, 0.3, 0.3],
+                       [-1, -1, -1, -1, -1],
+                       [-1, -1, -1, -1, -1]]], np.float32)
+    cls_pred = np.zeros((1, 6, 2), np.float32)
+    _, _, ct = mx.nd.multibox_target(
+        mx.nd.array(anchors), mx.nd.array(label), mx.nd.array(cls_pred),
+        overlap_threshold=0.9)
+    assert ct.asnumpy()[0, 0] == 5.0  # forced match survived, class 4+1
+
+
+def test_multibox_target_forces_best_anchor():
+    anchors = np.array([[[0.0, 0.0, 0.2, 0.2],
+                         [0.6, 0.6, 1.0, 1.0]]], np.float32)
+    # gt overlaps neither anchor above threshold, still must match best
+    label = np.array([[[0.0, 0.25, 0.25, 0.55, 0.55]]], np.float32)
+    cls_pred = np.zeros((1, 2, 2), np.float32)
+    _, bm, ct = mx.nd.multibox_target(
+        mx.nd.array(anchors), mx.nd.array(label), mx.nd.array(cls_pred),
+        overlap_threshold=0.5)
+    assert (ct.asnumpy() > 0).sum() == 1  # exactly the bipartite match
+
+
+def test_multibox_detection_decode_roundtrip():
+    anchors = np.array([[[0.1, 0.1, 0.5, 0.5],
+                         [0.4, 0.4, 0.9, 0.9]]], np.float32)
+    # loc_pred = 0 → decoded boxes == anchors
+    cls_prob = np.array([[[0.1, 0.8], [0.2, 0.1], [0.7, 0.1]]],
+                        np.float32)  # (B, C+1=3, N=2)
+    loc = np.zeros((1, 8), np.float32)
+    out = mx.nd.multibox_detection(
+        mx.nd.array(cls_prob), mx.nd.array(loc),
+        mx.nd.array(anchors)).asnumpy()
+    assert out.shape == (1, 2, 6)
+    # anchor 0: best fg class = 1 (p=.7) vs class 0 (p=.2)
+    assert out[0, 0, 0] == 1.0 and abs(out[0, 0, 1] - 0.7) < 1e-5
+    np.testing.assert_allclose(out[0, 0, 2:], anchors[0, 0], rtol=1e-5)
+    # anchor 1: class 0 fg p=.1 > .01 threshold
+    assert out[0, 1, 0] == 0.0
+
+
+def test_roi_align_identity_box():
+    """A ROI covering exactly one aligned cell grid reproduces values."""
+    B, C, H, W = 1, 2, 4, 4
+    data = np.arange(B * C * H * W, dtype=np.float32).reshape(B, C, H, W)
+    rois = np.array([[0, 0, 0, 4, 4]], np.float32)
+    out = mx.nd.roi_align(mx.nd.array(data), mx.nd.array(rois),
+                          pooled_size=(4, 4), spatial_scale=1.0,
+                          sample_ratio=1).asnumpy()
+    assert out.shape == (1, 2, 4, 4)
+    # sampling points land at cell centers - 0.5 offset → bilinear between
+    # neighbors; check monotonic structure + exact center value
+    assert np.all(np.diff(out[0, 0], axis=1) > 0)
+    big = mx.nd.roi_align(mx.nd.array(data), mx.nd.array(rois),
+                          pooled_size=(2, 2), spatial_scale=1.0,
+                          sample_ratio=2).asnumpy()
+    assert big.shape == (1, 2, 2, 2)
+    assert np.isfinite(big).all()
+
+
+def test_ssd_forward_and_loss():
+    from mxnet_tpu.models.vision import ssd_512_resnet50_v1_voc
+    from mxnet_tpu.models.vision.ssd import SSDMultiBoxLoss
+
+    net = ssd_512_resnet50_v1_voc()
+    mx.rng.seed(0)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.default_rng(0).standard_normal(
+        (2, 3, 128, 128)), dtype="float32")  # small spatial for CI speed
+    cls_pred, box_pred, anchors = net(x)
+    N = anchors.shape[1]
+    assert cls_pred.shape == (2, N, 21)
+    assert box_pred.shape == (2, N * 4)
+    assert anchors.shape[0] == 1 and anchors.shape[2] == 4
+
+    label = np.full((2, 3, 5), -1.0, np.float32)
+    label[0, 0] = [5, 0.1, 0.1, 0.4, 0.5]
+    label[1, 0] = [2, 0.5, 0.5, 0.9, 0.8]
+    label[1, 1] = [7, 0.0, 0.0, 0.3, 0.2]
+    bt, bm, ct = mx.nd.multibox_target(
+        anchors, mx.nd.array(label),
+        cls_pred.transpose((0, 2, 1)))
+    assert (ct.asnumpy() > 0).any()
+    lfn = SSDMultiBoxLoss()
+    with mx.autograd.record():
+        cp, bp, _ = net(x)
+        loss = lfn(cp, bp, ct, bt, bm).mean()
+    loss.backward()
+    assert np.isfinite(float(loss.asscalar()))
+    g = net.cls_heads._children["0"].weight.grad()
+    assert g is not None and float(np.abs(g.asnumpy()).sum()) > 0
+
+    det = net.detect(x)
+    assert det.shape == (2, N, 6)
+
+
+def test_ssd_pretrained_raises():
+    from mxnet_tpu.models.vision import ssd_512_resnet50_v1
+    with pytest.raises(MXNetError, match="pretrained"):
+        ssd_512_resnet50_v1(pretrained=True)
