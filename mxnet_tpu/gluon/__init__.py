@@ -20,6 +20,8 @@ def __getattr__(name):
         "Trainer": ("mxnet_tpu.gluon.trainer", "Trainer"),
         "metric": "mxnet_tpu.metric",
         "utils": "mxnet_tpu.gluon.utils",
+        "bucketing": "mxnet_tpu.gluon.bucketing",
+        "BucketingScheme": ("mxnet_tpu.gluon.bucketing", "BucketingScheme"),
     }
     if name in lazy:
         spec = lazy[name]

@@ -1,0 +1,71 @@
+"""Padded-bucket utilities — BucketingModule reborn for static shapes.
+
+Reference parity: python/mxnet/module/bucketing_module.py (SURVEY.md
+§3.3): the reference handles variable sequence length by binding one
+executor per bucket length, all sharing one parameter pool, with the data
+iterator tagging each batch with its bucket key. The TPU translation
+(SURVEY.md §7.3.2): TrainStep/EvalStep already cache one compiled program
+per batch signature; this module supplies the bucketing policy — pick the
+smallest bucket ≥ the realized length, pad the batch to it — so the
+number of distinct compiled programs is bounded by len(buckets) instead
+of the number of distinct raw lengths.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["BucketingScheme", "pad_to_bucket"]
+
+
+class BucketingScheme:
+    """A sorted set of bucket lengths (parity: the BucketingModule
+    `buckets` argument / gluon-nlp's FixedBucketSampler lengths)."""
+
+    def __init__(self, buckets):
+        if not buckets:
+            raise MXNetError("need at least one bucket length")
+        self.buckets = sorted(int(b) for b in buckets)
+
+    def bucket_for(self, length):
+        """Smallest bucket >= length (the padding target)."""
+        for b in self.buckets:
+            if length <= b:
+                return b
+        raise MXNetError(
+            f"length {length} exceeds largest bucket {self.buckets[-1]}")
+
+    def pad_batch(self, *arrays, axis=1, pad_value=0):
+        """Pad each array's `axis` to this scheme's bucket for the current
+        length. Returns (padded_arrays, bucket, valid_length). Arrays
+        whose `axis` dim differs from the first array's are passed
+        through untouched (labels etc.)."""
+        first = arrays[0]
+        length = first.shape[axis]
+        bucket = self.bucket_for(length)
+        out = []
+        for a in arrays:
+            if a.ndim <= axis or a.shape[axis] != length:
+                out.append(a)
+                continue
+            out.append(pad_to_bucket(a, bucket, axis=axis,
+                                     pad_value=pad_value))
+        return tuple(out), bucket, length
+
+
+def pad_to_bucket(array, bucket, axis=1, pad_value=0):
+    """Pad one array's `axis` up to `bucket` with pad_value."""
+    data = array._data if isinstance(array, NDArray) else jnp.asarray(array)
+    cur = data.shape[axis]
+    if cur > bucket:
+        raise MXNetError(f"length {cur} > bucket {bucket}")
+    if cur == bucket:
+        return array
+    widths = [(0, 0)] * data.ndim
+    widths[axis] = (0, bucket - cur)
+    padded = jnp.pad(data, widths, constant_values=pad_value)
+    return NDArray(padded) if isinstance(array, NDArray) else padded

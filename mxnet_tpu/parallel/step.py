@@ -96,8 +96,11 @@ class TrainStep:
         self._base_key = None
         self._lr_cache = None
         self._wd_cache = None
-        self._jitted = None
-        self._lower_args = None
+        # program cache keyed on the batch signature (shapes, dtypes,
+        # arity) — the BucketingModule story (SURVEY.md §3.3): each padded
+        # bucket size gets its own compiled program, parameters shared
+        self._programs = {}
+        self._last_sig = None
         self._meta = {}
         if self.mesh is not None:
             self._place_sharded()
@@ -216,8 +219,12 @@ class TrainStep:
     def __call__(self, *batch):
         datas = tuple(b._data if isinstance(b, NDArray) else jnp.asarray(b)
                       for b in batch)
-        if self._jitted is None:
-            self._jitted = self._build(len(datas))
+        sig = tuple((tuple(d.shape), str(d.dtype)) for d in datas)
+        entry = self._programs.get(sig)
+        if entry is None:
+            entry = {"jitted": self._build(len(datas)), "lower_args": None}
+            self._programs[sig] = entry
+        self._last_sig = sig
         if self._base_key is None:
             self._base_key = _rng.next_key()
         # cache device scalars for lr/wd — refresh only when the host value
@@ -236,16 +243,17 @@ class TrainStep:
                 datas = tuple(
                     jax.device_put(d, named_sharding(s))
                     for d, s in zip(datas, bspecs))
-        if self._lower_args is None:
+        if entry["lower_args"] is None:
             # shape structs for AOT lowering (compiled_cost_analysis);
             # can't keep the real arrays — they are donated below
-            self._lower_args = jax.tree_util.tree_map(
+            entry["lower_args"] = jax.tree_util.tree_map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                 (tuple(self._param_arrays), self._opt_states, self._t,
                  key, lr, wd) + datas)
         with _mesh_ctx(self.mesh):
-            out = self._jitted(tuple(self._param_arrays), self._opt_states,
-                               self._t, key, lr, wd, *datas)
+            out = entry["jitted"](tuple(self._param_arrays),
+                                  self._opt_states, self._t, key, lr, wd,
+                                  *datas)
         self._param_arrays, self._opt_states, self._t, loss, aux = out
         self._host_t += 1  # mirror of t — no device fetch in the hot loop
         self.optimizer.num_update = self._host_t
@@ -264,15 +272,14 @@ class TrainStep:
     def step_count(self):
         return self._host_t
 
-    def compiled_cost_analysis(self):
-        """XLA's cost analysis for the compiled step program (a dict with
+    def compiled_cost_analysis(self, sig=None):
+        """XLA's cost analysis for a compiled step program (a dict with
         'flops' etc.), or None before the first call / when the backend
         does not report costs. This is the authoritative per-step flop
-        count for MFU math — no hand-derived estimates."""
-        if self._jitted is None or self._lower_args is None:
-            return None
+        count for MFU math — no hand-derived estimates. sig selects a
+        program from the bucket cache; default = the last one called."""
         try:
-            compiled = self._lowered().compile()
+            compiled = self._lowered(sig).compile()
             ca = compiled.cost_analysis()
             if isinstance(ca, (list, tuple)):
                 ca = ca[0] if ca else None
@@ -280,11 +287,12 @@ class TrainStep:
         except Exception:
             return None
 
-    def _lowered(self):
-        """AOT-lower the step program (re-traces; mesh scope active so the
-        trace takes the same op routes as the live step)."""
+    def _lowered(self, sig=None):
+        """AOT-lower one cached step program (re-traces; mesh scope active
+        so the trace takes the same op routes as the live step)."""
+        entry = self._programs[sig if sig is not None else self._last_sig]
         with _mesh_ctx(self.mesh):
-            return self._jitted.lower(*self._lower_args)
+            return entry["jitted"].lower(*entry["lower_args"])
 
 
 class EvalStep:
@@ -295,7 +303,7 @@ class EvalStep:
         self.mesh = mesh if mesh is not None else current_mesh()
         self.batch_specs = batch_specs
         self._params = list(net.collect_params().values())
-        self._jitted = None
+        self._programs = {}
 
     def _build(self, n_batch):
         net, params = self.net, self._params
@@ -332,11 +340,14 @@ class EvalStep:
     def __call__(self, *batch):
         datas = tuple(b._data if isinstance(b, NDArray) else jnp.asarray(b)
                       for b in batch)
-        if self._jitted is None:
-            self._jitted = self._build(len(datas))
+        sig = tuple((tuple(d.shape), str(d.dtype)) for d in datas)
+        jitted = self._programs.get(sig)
+        if jitted is None:
+            jitted = self._build(len(datas))
+            self._programs[sig] = jitted
         key = _rng.next_key()
         param_datas = tuple(p.data()._data for p in self._params)
         with _mesh_ctx(self.mesh):
-            outs = self._jitted(param_datas, key, *datas)
+            outs = jitted(param_datas, key, *datas)
         res = tuple(NDArray(o) for o in outs)
         return res[0] if len(res) == 1 else res

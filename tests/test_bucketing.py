@@ -1,0 +1,86 @@
+"""TrainStep shape-keyed program cache + padded-bucket utilities
+(parity: BucketingModule, SURVEY.md §3.3 / §7.3.2; VERDICT r3 weak #3)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt, parallel as par
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import BucketingScheme, loss as gloss, nn
+
+
+def test_bucketing_scheme():
+    s = BucketingScheme([16, 32, 64])
+    assert s.bucket_for(1) == 16
+    assert s.bucket_for(16) == 16
+    assert s.bucket_for(17) == 32
+    with pytest.raises(MXNetError, match="exceeds"):
+        s.bucket_for(65)
+    ids = mx.nd.array(np.ones((2, 20)), dtype="int32")
+    vl = mx.nd.array(np.full((2,), 20), dtype="int32")
+    (pids, pvl), bucket, realized = s.pad_batch(ids, vl, axis=1)
+    assert bucket == 32 and realized == 20
+    assert pids.shape == (2, 32)
+    assert pvl.shape == (2,)  # non-seq array passed through
+    np.testing.assert_array_equal(pids.asnumpy()[:, 20:], 0)
+
+
+def _mk_step():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, flatten=False, in_units=4))
+    net.add(nn.Dense(3, flatten=False, in_units=8))
+    mx.rng.seed(0)
+    net.initialize(mx.init.Normal(0.1))
+    return par.TrainStep(net, gloss.L2Loss(), opt.SGD(learning_rate=0.01),
+                         mesh=None)
+
+
+def test_trainstep_program_per_bucket():
+    """Two batch shapes coexist: each gets its own compiled program, the
+    parameters are shared, and compiled_cost_analysis reports the right
+    program per signature (r1-r3 carryover: the cache was keyed on
+    nothing and silently reused the first arity/shapes)."""
+    step = _mk_step()
+    r = np.random.default_rng(0)
+    x16 = mx.nd.array(r.standard_normal((2, 16, 4)), dtype="float32")
+    y16 = mx.nd.array(r.standard_normal((2, 16, 3)), dtype="float32")
+    x32 = mx.nd.array(r.standard_normal((2, 32, 4)), dtype="float32")
+    y32 = mx.nd.array(r.standard_normal((2, 32, 3)), dtype="float32")
+
+    l1 = float(step(x16, y16).asscalar())
+    sig16 = step._last_sig
+    c16 = step.compiled_cost_analysis()
+    l2 = float(step(x32, y32).asscalar())
+    sig32 = step._last_sig
+    c32 = step.compiled_cost_analysis()
+    assert len(step._programs) == 2
+    assert sig16 != sig32
+    # flops scale with the doubled sequence dim; verify per-sig reporting
+    if c16 and c32 and c16.get("flops") and c32.get("flops"):
+        assert c32["flops"] > 1.5 * c16["flops"]
+        again16 = step.compiled_cost_analysis(sig16)
+        assert again16["flops"] == c16["flops"]
+    # alternating shapes keeps training (shared params, no rebuild)
+    l3 = float(step(x16, y16).asscalar())
+    assert len(step._programs) == 2
+    assert np.isfinite([l1, l2, l3]).all()
+    assert l3 < l1  # parameters advanced across both programs
+
+
+def test_trainstep_bucketed_bert_style():
+    """End-to-end: raw lengths 9/20/33 through a 3-bucket scheme compile
+    exactly 3 programs, not 3-per-unique-length on repeats."""
+    step = _mk_step()
+    scheme = BucketingScheme([16, 32, 64])
+    r = np.random.default_rng(1)
+    seen = set()
+    for length in (9, 20, 33, 12, 30, 60):
+        x = mx.nd.array(r.standard_normal((2, length, 4)), dtype="float32")
+        y = mx.nd.array(r.standard_normal((2, length, 3)), dtype="float32")
+        (xp, yp), bucket, _ = scheme.pad_batch(x, y, axis=1)
+        # labels share the seq axis here, so pad them too
+        yp = mx.gluon.bucketing.pad_to_bucket(y, bucket, axis=1)
+        loss = step(xp, yp)
+        assert np.isfinite(float(loss.asscalar()))
+        seen.add(bucket)
+    assert len(step._programs) == len(seen) == 3
