@@ -17,4 +17,5 @@ from .gpt2 import (  # noqa: F401,E402
     GPT2Config, GPT2ForCausalLM, GPT2Model, gpt2_774m_config,
     gpt2_medium_config, gpt2_small_config, gpt2_xl_config)
 from .kv_cache import KVCache, PagedKVCache  # noqa: F401,E402
+from .nmt import NMTConfig, TransformerNMT, nmt_base_config  # noqa: F401,E402
 from . import vision  # noqa: F401,E402

@@ -1,0 +1,334 @@
+"""Transformer NMT (Sockeye-3 style) with beam-search decoding.
+
+Reference parity: the Sockeye-3 target workload (BASELINE.md WMT En-De
+BLEU row; SURVEY.md §7.2 M9) — an encoder-decoder transformer trained
+with teacher forcing and decoded with length-penalized beam search.
+Sockeye-3's speed recipe (pre-norm blocks, fused ops, incremental decode
+states) maps here to: pre-LN blocks, one XLA program per step shape, and
+the static KVCache primitive (models/kv_cache.py) for the decoder's
+self-attention — beam state (tokens, scores, cache pages) advances inside
+a single lax.fori_loop program, the SURVEY §3.5 fix applied to beam
+search (the reference-era Sockeye re-concatenated decoder states per
+step).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..gluon.block import HybridBlock, _trace_channel
+from ..gluon.nn import Dense, Dropout, Embedding, LayerNorm
+from ..gluon.nn.transformer import MultiHeadAttention, PositionwiseFFN
+from ..ndarray.ndarray import NDArray
+from ..ops import nn as _opnn, init as _opinit
+from .kv_cache import KVCache
+
+__all__ = ["NMTConfig", "TransformerNMT", "nmt_base_config"]
+
+
+class NMTConfig:
+    def __init__(self, src_vocab_size=32000, tgt_vocab_size=32000,
+                 units=512, hidden_size=2048, enc_layers=6, dec_layers=6,
+                 num_heads=8, max_length=256, dropout=0.1,
+                 attention_dropout=0.0, layer_norm_eps=1e-5,
+                 activation="relu", bos_id=2, eos_id=3, pad_id=0,
+                 dtype="float32"):
+        self.src_vocab_size = src_vocab_size
+        self.tgt_vocab_size = tgt_vocab_size
+        self.units = units
+        self.hidden_size = hidden_size
+        self.enc_layers = enc_layers
+        self.dec_layers = dec_layers
+        self.num_heads = num_heads
+        self.max_length = max_length
+        self.dropout = dropout
+        self.attention_dropout = attention_dropout
+        self.layer_norm_eps = layer_norm_eps
+        self.activation = activation
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+        self.pad_id = pad_id
+        self.dtype = dtype
+
+
+def nmt_base_config(**kw):
+    return NMTConfig(**kw)
+
+
+def _sinusoid_positions(T, C, dtype):
+    pos = jnp.arange(T)[:, None].astype(jnp.float32)
+    dim = jnp.arange(C // 2)[None, :].astype(jnp.float32)
+    ang = pos / jnp.power(10000.0, 2 * dim / C)
+    return jnp.concatenate([jnp.sin(ang), jnp.cos(ang)],
+                           axis=-1).astype(dtype)
+
+
+class _EncoderLayer(HybridBlock):
+    """Pre-LN encoder layer (Sockeye-3 uses pre-norm for stability)."""
+
+    def __init__(self, c: NMTConfig, **kwargs):
+        super().__init__(**kwargs)
+        self.ln1 = LayerNorm(epsilon=c.layer_norm_eps, in_channels=c.units)
+        self.attn = MultiHeadAttention(c.units, c.num_heads,
+                                       dropout=c.attention_dropout)
+        self.ln2 = LayerNorm(epsilon=c.layer_norm_eps, in_channels=c.units)
+        self.ffn = PositionwiseFFN(c.units, c.hidden_size, c.activation,
+                                   c.dropout)
+        self.dropout = Dropout(c.dropout) if c.dropout else None
+
+    def forward(self, x, mask=None):
+        h = self.attn(self.ln1(x), mask)
+        if self.dropout is not None:
+            h = self.dropout(h)
+        x = x + h
+        h = self.ffn(self.ln2(x))
+        if self.dropout is not None:
+            h = self.dropout(h)
+        return x + h
+
+
+class _DecoderLayer(HybridBlock):
+    """Pre-LN decoder layer: causal self-attn (cache-capable) +
+    cross-attn over encoder memory + FFN."""
+
+    def __init__(self, c: NMTConfig, **kwargs):
+        super().__init__(**kwargs)
+        e = c.layer_norm_eps
+        self.ln1 = LayerNorm(epsilon=e, in_channels=c.units)
+        self.self_attn = MultiHeadAttention(c.units, c.num_heads,
+                                            dropout=c.attention_dropout,
+                                            causal=True)
+        self.ln2 = LayerNorm(epsilon=e, in_channels=c.units)
+        self.cross_attn = MultiHeadAttention(c.units, c.num_heads,
+                                             dropout=c.attention_dropout)
+        self.ln3 = LayerNorm(epsilon=e, in_channels=c.units)
+        self.ffn = PositionwiseFFN(c.units, c.hidden_size, c.activation,
+                                   c.dropout)
+        self.dropout = Dropout(c.dropout) if c.dropout else None
+        self._units = c.units
+        self._heads = c.num_heads
+
+    def _drop(self, h):
+        return self.dropout(h) if self.dropout is not None else h
+
+    def forward(self, x, memory, src_mask=None, cache=None,
+                layer_idx=None):
+        """cache=None: full teacher-forcing pass (causal self-attn).
+        cache given: incremental decode — x is (B, 1, C) and the
+        self-attention runs against the cache buffer."""
+        h = self.ln1(x)
+        if cache is None:
+            sa = self.self_attn(h)
+        else:
+            # project q/k/v through the SAME Dense layers, then attend
+            # over the cache (MultiHeadAttention internals, cache-routed)
+            a = self.self_attn
+            q = a._split(a.query(h))
+            k = a._split(a.key(h))
+            v = a._split(a.value(h))
+            k_all, v_all, cache = cache.write(layer_idx, k._data, v._data)
+            valid = cache.key_mask(extra=1)
+            mask = valid[None, None, None, :]
+            out = _opnn.dot_product_attention(
+                q, NDArray(k_all.astype(q._data.dtype)),
+                NDArray(v_all.astype(q._data.dtype)), NDArray(mask))
+            b, hh, t, d = out.shape
+            sa = a.proj(out.transpose((0, 2, 1, 3)).reshape(
+                (b, t, hh * d)))
+        x = x + self._drop(sa)
+        ca = self.cross_attn(self.ln2(x), mask=src_mask, kv=memory)
+        x = x + self._drop(ca)
+        x = x + self._drop(self.ffn(self.ln3(x)))
+        return x, cache
+
+
+class TransformerNMT(HybridBlock):
+    """Encoder-decoder transformer with tied target embedding/output."""
+
+    def __init__(self, config: NMTConfig, **kwargs):
+        super().__init__(**kwargs)
+        c = self.config = config
+        self.src_embed = Embedding(c.src_vocab_size, c.units, dtype=c.dtype)
+        self.tgt_embed = Embedding(c.tgt_vocab_size, c.units, dtype=c.dtype)
+        self.enc_dropout = Dropout(c.dropout) if c.dropout else None
+        for i in range(c.enc_layers):
+            self.register_child(_EncoderLayer(c), name=f"enc{i}")
+        self.enc_ln = LayerNorm(epsilon=c.layer_norm_eps,
+                                in_channels=c.units)
+        for i in range(c.dec_layers):
+            self.register_child(_DecoderLayer(c), name=f"dec{i}")
+        self.dec_ln = LayerNorm(epsilon=c.layer_norm_eps,
+                                in_channels=c.units)
+
+    def _enc_layers(self):
+        return [self._children[f"enc{i}"]
+                for i in range(self.config.enc_layers)]
+
+    def _dec_layers(self):
+        return [self._children[f"dec{i}"]
+                for i in range(self.config.dec_layers)]
+
+    # -- encode ------------------------------------------------------------
+    def encode(self, src, src_valid_length=None):
+        b, t = src.shape
+        c = self.config
+        x = self.src_embed(src) * (c.units ** 0.5)
+        x = x + NDArray(_sinusoid_positions(t, c.units, x._data.dtype))
+        if self.enc_dropout is not None:
+            x = self.enc_dropout(x)
+        mask = None
+        if src_valid_length is not None:
+            pos = _opinit.arange(0, t, dtype="int32")
+            mask = pos.reshape((1, t)) < src_valid_length.reshape((-1, 1))
+        for layer in self._enc_layers():
+            x = layer(x, mask)
+        return self.enc_ln(x), mask
+
+    # -- teacher-forcing forward ------------------------------------------
+    def forward(self, src, tgt, src_valid_length=None):
+        """Training pass: logits (B, T_tgt, V_tgt)."""
+        memory, src_mask = self.encode(src, src_valid_length)
+        c = self.config
+        b, t = tgt.shape
+        x = self.tgt_embed(tgt) * (c.units ** 0.5)
+        x = x + NDArray(_sinusoid_positions(t, c.units, x._data.dtype))
+        if self.enc_dropout is not None:
+            x = self.enc_dropout(x)
+        for i, layer in enumerate(self._dec_layers()):
+            x, _ = layer(x, memory, src_mask)
+        x = self.dec_ln(x)
+        w = self.tgt_embed.weight.data()
+        return _opnn.FullyConnected(x, w, None, no_bias=True, flatten=False)
+
+    def _decode_step(self, tok, memory, src_mask, cache):
+        """One incremental decoder step. tok (B, 1) → logits (B, V)."""
+        c = self.config
+        x = self.tgt_embed(tok) * (c.units ** 0.5)
+        pos = _sinusoid_positions(c.max_length, c.units, x._data.dtype)
+        x = x + NDArray(jax.lax.dynamic_slice_in_dim(
+            pos, cache.length, 1, axis=0))
+        for i, layer in enumerate(self._dec_layers()):
+            x, cache = layer(x, memory, src_mask, cache=cache, layer_idx=i)
+        cache = cache.advance(1)
+        x = self.dec_ln(x)
+        w = self.tgt_embed.weight.data()
+        logits = _opnn.FullyConnected(x, w, None, no_bias=True,
+                                      flatten=False)
+        return logits[:, 0, :], cache
+
+    # -- beam search -------------------------------------------------------
+    def translate(self, src, src_valid_length=None, beam_size=4,
+                  max_length=None, alpha=0.6):
+        """Length-penalized beam search (Sockeye/GNMT lp = ((5+len)/6)^α).
+        Returns (tokens (B, beam, L), scores (B, beam)) sorted best-first;
+        sequences end at eos and pad with eos after."""
+        c = self.config
+        K = int(beam_size)
+        max_length = int(max_length or c.max_length)
+        if max_length > c.max_length:
+            raise MXNetError(f"max_length {max_length} > model max "
+                             f"{c.max_length}")
+        ids = src._data if isinstance(src, NDArray) else jnp.asarray(src)
+        ids = ids.astype(jnp.int32)
+        B, Ts = ids.shape
+        vl = None if src_valid_length is None else (
+            src_valid_length._data if isinstance(src_valid_length, NDArray)
+            else jnp.asarray(src_valid_length)).astype(jnp.int32)
+
+        params = list(self.collect_params().values())
+        param_datas = tuple(p.data()._data for p in params)
+
+        def run(param_arrays, src_ids, src_vl):
+            saved = [p._data for p in params]
+            _trace_channel.push_frame()
+            try:
+                for p, d in zip(params, param_arrays):
+                    arr = NDArray(d)
+                    arr._grad_req = "null"
+                    p._data = arr
+                return self._beam_core(src_ids, src_vl, K, max_length,
+                                       alpha)
+            finally:
+                _trace_channel.pop_frame()
+                for p, d in zip(params, saved):
+                    p._data = d
+
+        cache_key = (B, Ts, K, max_length, alpha, vl is not None)
+        jitcache = self.__dict__.setdefault("_beam_cache", {})
+        fn = jitcache.get(cache_key)
+        if fn is None:
+            fn = jax.jit(run)
+            jitcache[cache_key] = fn
+        toks, scores = fn(param_datas, ids, vl)
+        return NDArray(toks), NDArray(scores)
+
+    def _beam_core(self, src_ids, src_vl, K, max_length, alpha):
+        c = self.config
+        B, Ts = src_ids.shape
+        NEG = -1e9
+
+        memory, src_mask = self.encode(
+            NDArray(src_ids),
+            None if src_vl is None else NDArray(src_vl))
+        mem = memory._data
+        # tile memory/mask to (B*K, ...)
+        mem = jnp.repeat(mem, K, axis=0)
+        smask = None
+        if src_mask is not None:
+            smask = NDArray(jnp.repeat(src_mask._data, K, axis=0))
+        mem_nd = NDArray(mem)
+
+        cache = KVCache.create(c.dec_layers, B * K, c.num_heads,
+                               max_length, c.units // c.num_heads,
+                               dtype=jnp.dtype(c.dtype))
+        toks0 = jnp.full((B, K, max_length), c.eos_id, jnp.int32)
+        # beam 0 active, others -inf so step 1 expands from one beam
+        scores0 = jnp.tile(
+            jnp.asarray([0.0] + [NEG] * (K - 1))[None, :], (B, 1))
+        finished0 = jnp.zeros((B, K), bool)
+        cur0 = jnp.full((B * K, 1), c.bos_id, jnp.int32)
+
+        def lp(length):
+            return jnp.power((5.0 + length) / 6.0, alpha)
+
+        def step(t, carry):
+            toks, scores, finished, cur, cache = carry
+            logits, cache = self._decode_step(NDArray(cur), mem_nd, smask,
+                                              cache)
+            logp = jax.nn.log_softmax(
+                logits._data.astype(jnp.float32), axis=-1)
+            V = logp.shape[-1]
+            logp = logp.reshape(B, K, V)
+            # finished beams only extend with eos at zero cost
+            eos_only = jnp.full((V,), NEG).at[c.eos_id].set(0.0)
+            logp = jnp.where(finished[..., None], eos_only[None, None, :],
+                             logp)
+            total = scores[..., None] + logp                 # (B, K, V)
+            flat = total.reshape(B, K * V)
+            new_scores, idx = jax.lax.top_k(flat, K)          # (B, K)
+            parent = idx // V                                 # (B, K)
+            token = (idx % V).astype(jnp.int32)
+            # reorder beam state by parent
+            gather = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+            toks = jnp.take_along_axis(
+                toks, parent[..., None], axis=1)
+            toks = toks.at[:, :, t].set(token)
+            finished = jnp.take_along_axis(finished, parent, axis=1)
+            finished = finished | (token == c.eos_id)
+            cache = KVCache(cache.k[:, gather], cache.v[:, gather],
+                            cache.length)
+            cur = token.reshape(B * K, 1)
+            return toks, new_scores, finished, cur, cache
+
+        toks, scores, finished, _, _ = jax.lax.fori_loop(
+            0, max_length, step,
+            (toks0, scores0, finished0, cur0, cache))
+        # length penalty: count tokens up to + including first eos
+        lengths = jnp.argmax(toks == c.eos_id, axis=-1) + 1
+        lengths = jnp.where(finished, lengths, max_length)
+        final = scores / lp(lengths.astype(jnp.float32))
+        order = jnp.argsort(-final, axis=1)
+        toks = jnp.take_along_axis(toks, order[..., None], axis=1)
+        final = jnp.take_along_axis(final, order, axis=1)
+        return toks, final
