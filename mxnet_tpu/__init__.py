@@ -41,6 +41,7 @@ def __getattr__(name):
         "kvstore": "mxnet_tpu.kvstore",
         "profiler": "mxnet_tpu.profiler",
         "parallel": "mxnet_tpu.parallel",
+        "checkpoint": "mxnet_tpu.checkpoint",
         "amp": "mxnet_tpu.amp",
         "io": "mxnet_tpu.io",
         "recordio": "mxnet_tpu.io.recordio",
@@ -62,4 +63,11 @@ def __getattr__(name):
             "tracing into XLA replaces the nnvm graph path (SURVEY.md "
             "§7.1); export/import graphs via HybridBlock.export "
             "(StableHLO) instead")
+    if name in ("module", "mod"):
+        raise AttributeError(
+            "the legacy Module/BucketingModule API is de-scoped (it rides "
+            "the Symbol/GraphExecutor path, SURVEY.md §3.3): use the "
+            "gluon Trainer or gluon.contrib.estimator.Estimator for the "
+            "fit loop, and gluon.bucketing.BucketingScheme + TrainStep's "
+            "per-shape program cache for the BucketingModule use case")
     raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

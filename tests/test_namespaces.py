@@ -12,7 +12,8 @@ def test_advertised_namespaces_import():
     for name in ("np", "npx", "gluon", "optimizer", "metric", "initializer",
                  "init", "lr_scheduler", "kv", "kvstore", "parallel", "io",
                  "recordio", "test_utils", "runtime", "engine", "context",
-                 "functional", "models", "amp", "profiler", "image"):
+                 "functional", "models", "amp", "profiler", "image",
+                 "checkpoint"):
         mod = getattr(mx, name)
         assert mod is not None, name
 
@@ -22,6 +23,13 @@ def test_symbol_descope_message():
         mx.sym
     with pytest.raises(AttributeError, match="HybridBlock"):
         mx.symbol
+
+
+def test_module_descope_message():
+    with pytest.raises(AttributeError, match="BucketingScheme"):
+        mx.module
+    with pytest.raises(AttributeError, match="Estimator"):
+        mx.mod
 
 
 def test_np_basics():
