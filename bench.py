@@ -280,6 +280,49 @@ def bench_gpt2_decode():
     return 0
 
 
+def bench_decode():
+    """Data-pipeline decode throughput (img/sec through ImageRecordIter's
+    native libjpeg path — the reference's iter_image_recordio_2.cc role,
+    SURVEY.md §2.5). Synthesizes a RecordIO pack of JPEGs, then measures
+    end-to-end decode+resize+batch throughput."""
+    import tempfile
+    import cv2
+    import mxnet_tpu as mx
+    from mxnet_tpu.io import ImageRecordIter, MXRecordIO, IRHeader, pack
+
+    n_images = int(os.environ.get("BENCH_DECODE_IMAGES", 512))
+    size = int(os.environ.get("BENCH_DECODE_SIZE", 480))
+    out_size = 224
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "bench.rec")
+        rec = MXRecordIO(path, "w")
+        img = rng.integers(0, 255, (size, size, 3)).astype(np.uint8)
+        ok, buf = cv2.imencode(".jpg", img)
+        payload = pack(IRHeader(0, 0.0, 0, 0), bytes(buf.tobytes()))
+        for i in range(n_images):
+            rec.write(payload)
+        rec.close()
+        it = ImageRecordIter(path, batch_size=32,
+                             data_shape=(3, out_size, out_size),
+                             to_device=False)
+        for _ in it:  # warmup epoch (thread pool spin-up)
+            pass
+        t0 = time.perf_counter()
+        n = 0
+        for data, label in it:
+            n += data.shape[0]
+        dt = time.perf_counter() - t0
+    native = it._decoder.is_native
+    _emit("decode_pipeline_img_per_sec", round(n / dt, 1), "img/sec",
+          0.0, extras={
+              "images": n, "src_size": size, "out_size": out_size,
+              "threads": it._threads, "native_decoder": native,
+              "baseline": "none recorded (reference pipeline not runnable "
+                          "here)"})
+    return 0
+
+
 def main():
     import jax
     # rbg (hardware RNG) for dropout masks: threefry mask generation costs
@@ -316,6 +359,8 @@ def main():
         return bench_resnet50()
     if workload in ("gpt2", "gpt2_decode", "gpt2_774m"):
         return bench_gpt2_decode()
+    if workload == "decode":
+        return bench_decode()
     _emit("unknown_workload", 0.0, "none", 0.0, error=workload)
     return 1
 

@@ -8,3 +8,5 @@ from .recordio import (  # noqa: F401
     IRHeader, MXIndexedRecordIO, MXRecordIO, pack, pack_img, unpack,
     unpack_img)
 from .io import DataBatch, DataDesc, DataIter, NDArrayIter  # noqa: F401
+from .pipeline import (  # noqa: F401
+    ImageRecordIter, NativeJpegDecoder, decode_jpeg)

@@ -1,0 +1,263 @@
+"""High-throughput image pipeline: native decode + threaded prefetch.
+
+Reference parity: src/io/iter_image_recordio_2.cc — ImageRecordIter2, the
+C++ pipeline behind the reference's ResNet img/sec numbers (SURVEY.md
+§2.5 'C++ data pipeline', §7.1's one genuine "Yes (C++)" native-code
+commitment): multi-threaded JPEG decode + augment, double-buffered into
+pinned batches. Here:
+
+  * decode is the native libjpeg extension (_decode.cpp, built lazily
+    with g++, cv2 fallback) called through ctypes — the GIL is RELEASED
+    during each call, so a ThreadPoolExecutor of plain Python threads
+    decodes truly in parallel (the dmlc ThreadedIter analog);
+  * ImageRecordIter reads RecordIO packs (io/recordio.py, format-
+    compatible with the reference), decodes + augments + batches on the
+    pool, and PREFETCHES: `prefetch` batches are always in flight, and
+    each batch is handed to jax asynchronously so host decode of batch
+    N+1 overlaps device compute of batch N;
+  * bench: `python bench.py --workload decode` measures images/sec
+    through this pipeline.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import queue
+import subprocess
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["NativeJpegDecoder", "decode_jpeg", "ImageRecordIter"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "_decode.cpp")
+_SO = os.path.join(_HERE, "_decode.so")
+_lock = threading.Lock()
+_lib = None
+_lib_err = None
+
+
+def _build_lib():
+    cmd = ["g++", "-O2", "-fPIC", "-shared", _SRC, "-ljpeg", "-o",
+           _SO + ".tmp"]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise MXNetError(
+            f"native decoder build failed: {proc.stderr[-500:]}")
+    os.replace(_SO + ".tmp", _SO)
+
+
+def _load_lib():
+    """Build (once) and load the native decoder; raises on failure."""
+    global _lib, _lib_err
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _lib_err is not None:
+            raise _lib_err
+        try:
+            if not os.path.exists(_SO) or (
+                    os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build_lib()
+            lib = ctypes.CDLL(_SO)
+            lib.mxtpu_jpeg_dims.restype = ctypes.c_int
+            lib.mxtpu_jpeg_dims.argtypes = [
+                ctypes.c_char_p, ctypes.c_ulong,
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_int)]
+            lib.mxtpu_jpeg_decode.restype = ctypes.c_int
+            lib.mxtpu_jpeg_decode.argtypes = [
+                ctypes.c_char_p, ctypes.c_ulong, ctypes.c_void_p,
+                ctypes.c_int, ctypes.c_int]
+            _lib = lib
+            return lib
+        except Exception as e:  # remember the failure; callers fall back
+            _lib_err = e if isinstance(e, MXNetError) else MXNetError(
+                f"native decoder unavailable: {e}")
+            raise _lib_err
+
+
+class NativeJpegDecoder:
+    """libjpeg-backed decoder with a cv2 fallback (the reference decodes
+    through OpenCV; both paths yield identical RGB uint8 HWC)."""
+
+    def __init__(self, force_fallback=False):
+        self._native = None
+        if not force_fallback:
+            try:
+                self._native = _load_lib()
+            except MXNetError:
+                self._native = None
+
+    @property
+    def is_native(self):
+        return self._native is not None
+
+    def decode(self, buf):
+        """JPEG bytes → (H, W, 3) RGB uint8 ndarray."""
+        buf = bytes(buf)
+        if self._native is not None:
+            h = ctypes.c_int()
+            w = ctypes.c_int()
+            c = ctypes.c_int()
+            if self._native.mxtpu_jpeg_dims(
+                    buf, len(buf), ctypes.byref(h), ctypes.byref(w),
+                    ctypes.byref(c)) == 0:
+                out = _np.empty((h.value, w.value, 3), _np.uint8)
+                rc = self._native.mxtpu_jpeg_decode(
+                    buf, len(buf), out.ctypes.data, h.value, w.value)
+                if rc == 0:
+                    return out
+            # corrupt/non-JPEG → fall through to cv2/PIL
+        from ..image import _decode_np
+        return _decode_np(buf, flag=1, to_rgb=True)
+
+
+_default_decoder = None
+
+
+def decode_jpeg(buf):
+    """Module-level convenience over a shared NativeJpegDecoder."""
+    global _default_decoder
+    if _default_decoder is None:
+        _default_decoder = NativeJpegDecoder()
+    return _default_decoder.decode(buf)
+
+
+class ImageRecordIter:
+    """Parity: io.ImageRecordIter (src/io/iter_image_recordio_2.cc).
+
+    Reads a RecordIO pack of IRHeader+JPEG records (tools/im2rec format),
+    decodes on a thread pool through the native decoder, optionally
+    resizes/augments, and yields device-bound batches with `prefetch`
+    batches pipelined ahead of the consumer.
+
+    Yields DataBatch-like (data (B, 3, H, W) float32 NDArray,
+    label (B,) float32 NDArray).
+    """
+
+    def __init__(self, path_imgrec, batch_size, data_shape,
+                 shuffle=False, aug_list=None, num_threads=None,
+                 prefetch=2, seed=0, to_device=True):
+        from .recordio import MXRecordIO, unpack
+        self._path = path_imgrec
+        self.batch_size = int(batch_size)
+        self.data_shape = tuple(data_shape)   # (3, H, W)
+        self._shuffle = shuffle
+        self._augs = aug_list or []
+        self._threads = num_threads or min(8, os.cpu_count() or 4)
+        self._prefetch = max(1, int(prefetch))
+        self._seed = seed
+        self._epoch = 0
+        self._to_device = to_device
+        self._decoder = NativeJpegDecoder()
+        # index the pack once: read all records into memory offsets
+        rec = MXRecordIO(path_imgrec, "r")
+        self._records = []
+        while True:
+            item = rec.read()
+            if item is None:
+                break
+            self._records.append(item)
+        rec.close()
+        if not self._records:
+            raise MXNetError(f"empty RecordIO file {path_imgrec}")
+        self._unpack = unpack
+
+    def __len__(self):
+        return len(self._records) // self.batch_size
+
+    def _decode_one(self, raw):
+        header, img_bytes = self._unpack(raw)
+        img = self._decoder.decode(img_bytes)
+        c, H, W = self.data_shape
+        if img.shape[0] != H or img.shape[1] != W:
+            # pure host-side resize (no per-image device roundtrip)
+            try:
+                import cv2
+                img = cv2.resize(img, (W, H),
+                                 interpolation=cv2.INTER_LINEAR)
+            except ImportError:
+                from ..image import imresize
+                img = imresize(img, W, H).asnumpy()
+        for aug in self._augs:
+            from ..ndarray.ndarray import NDArray
+            out = aug(NDArray(img))
+            img = out.asnumpy() if hasattr(out, "asnumpy") else out
+        label = header.label
+        lab = float(label if _np.isscalar(label) else _np.asarray(
+            label).reshape(-1)[0])
+        return img.transpose(2, 0, 1).astype(_np.float32), lab
+
+    def __iter__(self):
+        from ..ndarray.ndarray import NDArray
+        import jax.numpy as jnp
+
+        order = _np.arange(len(self._records))
+        if self._shuffle:
+            rng = _np.random.default_rng(self._seed + self._epoch)
+            rng.shuffle(order)
+        self._epoch += 1
+        n_batches = len(self)
+        pool = ThreadPoolExecutor(self._threads)
+        q = queue.Queue(maxsize=self._prefetch)
+        stop = threading.Event()
+
+        def put(item):
+            # bounded put that re-checks stop: an abandoned consumer (early
+            # break) must not leave the producer blocked forever on a full
+            # queue (which would leak this thread + the pool per epoch)
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for b in range(n_batches):
+                    if stop.is_set():
+                        return
+                    idx = order[b * self.batch_size:
+                                (b + 1) * self.batch_size]
+                    futs = [pool.submit(self._decode_one,
+                                        self._records[i]) for i in idx]
+                    imgs, labels = zip(*[f.result() for f in futs])
+                    data = _np.stack(imgs)
+                    lab = _np.asarray(labels, _np.float32)
+                    if self._to_device:
+                        # async H2D: jnp.asarray dispatches without
+                        # blocking; device copy overlaps the next decode
+                        batch = (NDArray(jnp.asarray(data)),
+                                 NDArray(jnp.asarray(lab)))
+                    else:
+                        batch = (data, lab)
+                    if not put(batch):
+                        return
+                put(None)
+            except Exception as e:  # surface in the consumer
+                put(e)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    break
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            pool.shutdown(wait=False)
+
+    def reset(self):
+        """Parity: DataIter.reset — reshuffle for the next epoch (state
+        advances in __iter__)."""
