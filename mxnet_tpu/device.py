@@ -74,14 +74,22 @@ class Device:
 
     def memory_info(self):
         """Free/total HBM if the backend reports it, else (None, None)."""
-        d = self.jax_device
-        stats = getattr(d, "memory_stats", lambda: None)()
+        stats = self.memory_stats()
         if not stats:
             return (None, None)
         limit = stats.get("bytes_limit")
         in_use = stats.get("bytes_in_use")
         free = limit - in_use if (limit is not None and in_use is not None) else None
         return (free, limit)
+
+    def memory_stats(self):
+        """Raw PjRt allocator statistics (bytes_in_use, peak_bytes_in_use,
+        bytes_limit, num_allocs, …) or {} when the backend doesn't report
+        them. The memory-stats API the reference exposes via
+        mx.context.gpu_memory_info + the profiler's memory counters
+        (SURVEY.md §7.1: 'expose memory stats API')."""
+        d = self.jax_device
+        return dict(getattr(d, "memory_stats", lambda: None)() or {})
 
 
 # Context is the historical name throughout the reference's API surface.
@@ -181,3 +189,18 @@ def from_jax_device(jd: jax.Device) -> Device:
     devs = _devices_for(jd.platform)
     dt = "tpu" if jd.platform == "tpu" else "gpu"
     return Device(dt, devs.index(jd))
+
+
+def gpu_memory_info(device_id=0):
+    """(free_bytes, total_bytes) for an accelerator device (parity:
+    mx.context.gpu_memory_info; on this framework the accelerator is
+    normally a TPU — the name is kept for script compatibility)."""
+    plat = _accelerator_platform()
+    if plat is None:
+        from .base import MXNetError
+        raise MXNetError("no accelerator device present")
+    dev = tpu(device_id) if plat == "tpu" else gpu(device_id)
+    return dev.memory_info()
+
+
+tpu_memory_info = gpu_memory_info

@@ -1,0 +1,7 @@
+"""Estimator: the Keras-like fit loop with event handlers (parity:
+python/mxnet/gluon/contrib/estimator/)."""
+from .estimator import Estimator  # noqa: F401
+from .event_handler import (  # noqa: F401
+    BatchBegin, BatchEnd, CheckpointHandler, EarlyStoppingHandler,
+    EpochBegin, EpochEnd, EventHandler, LoggingHandler, StopTraining,
+    TrainBegin, TrainEnd, ValidationHandler)

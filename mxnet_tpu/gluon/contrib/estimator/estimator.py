@@ -1,0 +1,131 @@
+"""Estimator.fit — the Keras-like training loop.
+
+Reference parity: gluon/contrib/estimator/estimator.py — Estimator(net,
+loss, train_metrics, trainer).fit(train_data, val_data, epochs) firing
+{Train,Epoch,Batch}{Begin,End} events on the installed handlers
+(SURVEY.md §2.5 Estimator row, §5.5 observability).
+
+TPU-native note: the batch step runs through the eager autograd path by
+default (simple, debuggable — the reference's behavior); pass
+`fused=True` to compile the whole step into one XLA program via
+parallel.TrainStep (same numerics, the perf path).
+"""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ....metric import EvalMetric, Loss as LossMetric
+from ... import loss as gloss
+from ...trainer import Trainer
+from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
+                            LoggingHandler, StopTraining, TrainBegin,
+                            TrainEnd)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, trainer=None,
+                 context=None, val_metrics=None, fused=False):
+        self.net = net
+        if not isinstance(loss, gloss.Loss):
+            raise MXNetError("loss must be a gluon.loss.Loss")
+        self.loss = loss
+        self.train_metrics = self._as_metrics(train_metrics)
+        self.val_metrics = val_metrics if val_metrics is not None else \
+            [type(m)() if not isinstance(m, LossMetric) else LossMetric()
+             for m in self.train_metrics]
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "adam", {"learning_rate": 1e-3},
+            kvstore=None)
+        self.max_epoch = None
+        self._fused = fused
+        self._train_step = None
+
+    @staticmethod
+    def _as_metrics(metrics):
+        if metrics is None:
+            return [LossMetric()]
+        if isinstance(metrics, EvalMetric):
+            metrics = [metrics]
+        out = list(metrics)
+        if not any(isinstance(m, LossMetric) for m in out):
+            out.append(LossMetric())
+        return out
+
+    # -- events ------------------------------------------------------------
+    @staticmethod
+    def _fire(handlers, kind, estimator, **kwargs):
+        mixin = {"train_begin": TrainBegin, "train_end": TrainEnd,
+                 "epoch_begin": EpochBegin, "epoch_end": EpochEnd,
+                 "batch_begin": BatchBegin, "batch_end": BatchEnd}[kind]
+        for h in handlers:
+            if isinstance(h, mixin):
+                getattr(h, kind)(estimator, **kwargs)
+
+    # -- the loop ----------------------------------------------------------
+    def fit(self, train_data, val_data=None, epochs=1,
+            event_handlers=None, batch_fn=None):
+        """train_data: iterable of (data, label) batches (DataLoader or
+        DataIter). Returns self."""
+        from .... import autograd
+
+        handlers = list(event_handlers or [])
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler())
+        self.max_epoch = epochs
+        self._fire(handlers, "train_begin", self)
+        try:
+            for epoch in range(epochs):
+                for m in self.train_metrics:
+                    m.reset()
+                self._fire(handlers, "epoch_begin", self, epoch=epoch)
+                for batch in train_data:
+                    data, label = batch_fn(batch) if batch_fn else batch
+                    self._fire(handlers, "batch_begin", self,
+                               batch=(data, label))
+                    if self._fused:
+                        loss = self._fused_step(data, label)
+                        out = None
+                    else:
+                        with autograd.record():
+                            out = self.net(data)
+                            loss = self.loss(out, label)
+                        loss.backward()
+                        self.trainer.step(data.shape[0])
+                    for m in self.train_metrics:
+                        if isinstance(m, LossMetric):
+                            m.update(None, loss)
+                        elif out is not None:
+                            m.update(label, out)
+                    self._fire(handlers, "batch_end", self,
+                               batch=(data, label), loss=loss)
+                if val_data is not None:
+                    self.evaluate(val_data, batch_fn=batch_fn)
+                self._fire(handlers, "epoch_end", self, epoch=epoch)
+        except StopTraining:
+            pass
+        self._fire(handlers, "train_end", self)
+        return self
+
+    def _fused_step(self, data, label):
+        if self._train_step is None:
+            from ....parallel import TrainStep
+            self._train_step = TrainStep(
+                self.net, self.loss, self.trainer.optimizer, mesh=None)
+        loss = self._train_step(data, label)
+        self._train_step.sync_params()
+        return loss
+
+    def evaluate(self, val_data, batch_fn=None):
+        for m in self.val_metrics:
+            m.reset()
+        for batch in val_data:
+            data, label = batch_fn(batch) if batch_fn else batch
+            out = self.net(data)
+            loss = self.loss(out, label)
+            for m in self.val_metrics:
+                if isinstance(m, LossMetric):
+                    m.update(None, loss)
+                else:
+                    m.update(label, out)
+        return {m.get()[0]: m.get()[1] for m in self.val_metrics}

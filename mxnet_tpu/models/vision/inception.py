@@ -1,0 +1,164 @@
+"""Inception V3 (reference parity: gluon/model_zoo/vision/inception.py —
+the zoo's inception_v3 entry; 299x299 input)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...gluon.block import HybridBlock
+from ...gluon.nn import (AvgPool2D, BatchNorm, Conv2D, Dense, Dropout,
+                         GlobalAvgPool2D, HybridSequential, MaxPool2D)
+from ...ops import nn as _opnn
+from ...ops import tensor as _opt
+
+__all__ = ["Inception3", "inception_v3"]
+
+
+def _conv(channels, kernel_size, strides=1, padding=0):
+    out = HybridSequential()
+    out.add(Conv2D(channels, kernel_size=kernel_size, strides=strides,
+                   padding=padding, use_bias=False))
+    out.add(BatchNorm(epsilon=0.001))
+    out.add(_Relu())
+    return out
+
+
+class _Relu(HybridBlock):
+    def forward(self, x):
+        return _opnn.Activation(x, act_type="relu")
+
+
+class _Branches(HybridBlock):
+    """Run child branches on the same input, concat on channels."""
+
+    def __init__(self, branches, **kwargs):
+        super().__init__(**kwargs)
+        for i, b in enumerate(branches):
+            self.register_child(b, name=f"b{i}")
+
+    def forward(self, x):
+        outs = [child(x) for child in self._children.values()]
+        return _opt.concat(*outs, dim=1)
+
+
+def _pool_branch(pool_type, channels):
+    out = HybridSequential()
+    if pool_type == "avg":
+        out.add(AvgPool2D(pool_size=3, strides=1, padding=1))
+    else:
+        out.add(MaxPool2D(pool_size=3, strides=2))
+    if channels:
+        out.add(_conv(channels, 1))
+    return out
+
+
+def _make_A(pool_features):
+    b0 = _conv(64, 1)
+    b1 = HybridSequential()
+    b1.add(_conv(48, 1))
+    b1.add(_conv(64, 5, padding=2))
+    b2 = HybridSequential()
+    b2.add(_conv(64, 1))
+    b2.add(_conv(96, 3, padding=1))
+    b2.add(_conv(96, 3, padding=1))
+    return _Branches([b0, b1, b2, _pool_branch("avg", pool_features)])
+
+
+def _make_B():
+    b0 = _conv(384, 3, strides=2)
+    b1 = HybridSequential()
+    b1.add(_conv(64, 1))
+    b1.add(_conv(96, 3, padding=1))
+    b1.add(_conv(96, 3, strides=2))
+    return _Branches([b0, b1, _pool_branch("max", 0)])
+
+
+def _make_C(channels_7x7):
+    c7 = channels_7x7
+    b0 = _conv(192, 1)
+    b1 = HybridSequential()
+    b1.add(_conv(c7, 1))
+    b1.add(_conv(c7, (1, 7), padding=(0, 3)))
+    b1.add(_conv(192, (7, 1), padding=(3, 0)))
+    b2 = HybridSequential()
+    b2.add(_conv(c7, 1))
+    b2.add(_conv(c7, (7, 1), padding=(3, 0)))
+    b2.add(_conv(c7, (1, 7), padding=(0, 3)))
+    b2.add(_conv(c7, (7, 1), padding=(3, 0)))
+    b2.add(_conv(192, (1, 7), padding=(0, 3)))
+    return _Branches([b0, b1, b2, _pool_branch("avg", 192)])
+
+
+def _make_D():
+    b0 = HybridSequential()
+    b0.add(_conv(192, 1))
+    b0.add(_conv(320, 3, strides=2))
+    b1 = HybridSequential()
+    b1.add(_conv(192, 1))
+    b1.add(_conv(192, (1, 7), padding=(0, 3)))
+    b1.add(_conv(192, (7, 1), padding=(3, 0)))
+    b1.add(_conv(192, 3, strides=2))
+    return _Branches([b0, b1, _pool_branch("max", 0)])
+
+
+class _SplitConcat(HybridBlock):
+    """1x3 + 3x1 parallel convs concatenated (the E-block tail)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.a = _conv(384, (1, 3), padding=(0, 1))
+        self.b = _conv(384, (3, 1), padding=(1, 0))
+
+    def forward(self, x):
+        return _opt.concat(self.a(x), self.b(x), dim=1)
+
+
+def _make_E():
+    b0 = _conv(320, 1)
+    b1 = HybridSequential()
+    b1.add(_conv(384, 1))
+    b1.add(_SplitConcat())
+    b2 = HybridSequential()
+    b2.add(_conv(448, 1))
+    b2.add(_conv(384, 3, padding=1))
+    b2.add(_SplitConcat())
+    return _Branches([b0, b1, b2, _pool_branch("avg", 192)])
+
+
+class Inception3(HybridBlock):
+    """Inception V3 (parity: model_zoo Inception3; 299x299)."""
+
+    def __init__(self, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        f = self.features = HybridSequential()
+        f.add(_conv(32, 3, strides=2))
+        f.add(_conv(32, 3))
+        f.add(_conv(64, 3, padding=1))
+        f.add(MaxPool2D(pool_size=3, strides=2))
+        f.add(_conv(80, 1))
+        f.add(_conv(192, 3))
+        f.add(MaxPool2D(pool_size=3, strides=2))
+        f.add(_make_A(32))
+        f.add(_make_A(64))
+        f.add(_make_A(64))
+        f.add(_make_B())
+        f.add(_make_C(128))
+        f.add(_make_C(160))
+        f.add(_make_C(160))
+        f.add(_make_C(192))
+        f.add(_make_D())
+        f.add(_make_E())
+        f.add(_make_E())
+        f.add(AvgPool2D(pool_size=8))
+        f.add(Dropout(0.5))
+        self.output = Dense(classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.reshape((x.shape[0], -1))
+        return self.output(x)
+
+
+def inception_v3(pretrained=False, classes=1000, **kwargs):
+    if pretrained:
+        raise MXNetError("pretrained weights are not bundled; use "
+                         "load_parameters() with a local file")
+    return Inception3(classes=classes, **kwargs)
