@@ -42,6 +42,9 @@ def __getattr__(name):
         "profiler": "mxnet_tpu.profiler",
         "parallel": "mxnet_tpu.parallel",
         "checkpoint": "mxnet_tpu.checkpoint",
+        "operator": "mxnet_tpu.operator",
+        "config": "mxnet_tpu.config",
+        "contrib": "mxnet_tpu.contrib",
         "amp": "mxnet_tpu.amp",
         "io": "mxnet_tpu.io",
         "recordio": "mxnet_tpu.io.recordio",

@@ -29,7 +29,7 @@ import jax.numpy as jnp
 
 from .base import MXNetError
 
-__all__ = ["TrainCheckpoint"]
+__all__ = ["TrainCheckpoint", "install_preemption_handler"]
 
 
 def _ocp():
@@ -144,3 +144,40 @@ class TrainCheckpoint:
 
     def close(self):
         self._mgr.close()
+
+
+def install_preemption_handler(ckpt, train_step, get_step,
+                               get_cursor=None, signals=None):
+    """Preemption-tolerant training (SURVEY.md §5.3 — a gap in the
+    reference, closed here): on SIGTERM (the TPU-VM maintenance/preempt
+    notice), synchronously checkpoint the full training state + data
+    cursor, then re-raise the default handler so the process exits.
+    Returns a remover callable.
+
+    Usage:
+        remove = install_preemption_handler(
+            ckpt, step, get_step=lambda: step.step_count,
+            get_cursor=lambda: {"epoch": epoch, "batch": i})
+    """
+    import signal as _signal
+
+    signals = signals or [_signal.SIGTERM]
+    previous = {}
+
+    def handler(signum, frame):
+        ckpt.save(int(get_step()), train_step,
+                  data_cursor=get_cursor() if get_cursor else None,
+                  wait=True)
+        prev = previous.get(signum)
+        _signal.signal(signum, prev if prev is not None else
+                       _signal.SIG_DFL)
+        _signal.raise_signal(signum)
+
+    for s in signals:
+        previous[s] = _signal.signal(s, handler)
+
+    def remove():
+        for s, prev in previous.items():
+            _signal.signal(s, prev if prev is not None else _signal.SIG_DFL)
+
+    return remove

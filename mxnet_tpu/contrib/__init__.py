@@ -1,0 +1,3 @@
+"""mx.contrib (parity: python/mxnet/contrib/ — quantization here; amp
+lives at mx.amp as in v2)."""
+from . import quantization  # noqa: F401

@@ -1,0 +1,183 @@
+"""Post-training INT8 quantization.
+
+Reference parity: src/operator/quantization/ (quantize_v2, dequantize,
+quantized_fully_connected, requantize, calibrate.cc) + python
+contrib/quantization.py quantize_net (SURVEY.md §2.3 'Quantization').
+The reference calibrates activation ranges over a dataset (minmax /
+entropy) then runs int8 kernels through oneDNN/cuDNN; here the int8
+matmul is one lax.dot_general with int32 accumulation — which XLA:TPU
+executes natively — and calibration is a forward-hook pass.
+
+Scope (the reference's main path): symmetric per-tensor int8 for Dense
+layers via `quantize_net(net, calib_data)`; conv quantization follows
+the same recipe and is left to user code for now (documented)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from ..gluon.block import HybridBlock
+from ..gluon.nn import Dense
+from ..ndarray.ndarray import NDArray
+from ..ops.registry import apply_op, op
+
+__all__ = ["quantize_v2", "dequantize", "quantized_fully_connected",
+           "QuantizedDense", "quantize_net", "calib_ranges"]
+
+
+@op("quantize_v2", nodiff=True)
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """f32 → int8 with symmetric scale (parity: quantize_v2). Returns
+    (q, min_range, max_range)."""
+    if out_type != "int8":
+        raise MXNetError("only int8 quantization is supported")
+    if (min_calib_range is None) != (max_calib_range is None):
+        raise MXNetError("min_calib_range and max_calib_range must be "
+                         "given together (or both omitted)")
+    if min_calib_range is None:
+        amax = jnp.max(jnp.abs(data))
+    else:
+        amax = jnp.maximum(abs(float(min_calib_range)),
+                           abs(float(max_calib_range)))
+    scale = 127.0 / jnp.maximum(amax, 1e-8)
+    q = jnp.clip(jnp.round(data.astype(jnp.float32) * scale),
+                 -127, 127).astype(jnp.int8)
+    return q, -amax * jnp.ones(()), amax * jnp.ones(())
+
+
+@op("dequantize", nodiff=True)
+def dequantize(data, min_range, max_range, out_type="float32"):
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return data.astype(jnp.float32) * (amax / 127.0)
+
+
+def _quantize_sym(x, amax):
+    """The ONE clip/round/scale recipe every int8 path uses."""
+    scale = 127.0 / max(float(amax), 1e-8)
+    return jnp.clip(jnp.round(x.astype(jnp.float32) * scale),
+                    -127, 127).astype(jnp.int8)
+
+
+def _int8_matmul(x_q, w_q):
+    # int8 × int8 → int32 accumulation on the MXU
+    return lax.dot_general(
+        x_q, w_q, (((x_q.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+
+@op("quantized_fully_connected", nodiff=True)
+def quantized_fully_connected(x_q, w_q, x_amax, w_amax, bias=None):
+    """int8 activations (.., K) × int8 weights (N, K) → f32 (.., N)
+    (parity: quantized_fully_connected + requantize folded in)."""
+    acc = _int8_matmul(x_q, w_q)
+    scale = (x_amax / 127.0) * (w_amax / 127.0)
+    y = acc.astype(jnp.float32) * scale
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+class QuantizedDense(HybridBlock):
+    """Dense replaced by int8 weight + calibrated activation range."""
+
+    def __init__(self, dense: Dense, act_amax, **kwargs):
+        super().__init__(**kwargs)
+        w = dense.weight.data()._data
+        amax_w = float(jnp.max(jnp.abs(w)))
+        self._w_q = _quantize_sym(w, amax_w)
+        self._w_amax = amax_w
+        self._act_amax = float(act_amax)
+        self._bias = (dense.bias.data()._data
+                      if getattr(dense, "bias", None) is not None else None)
+        self._flatten = getattr(dense, "_flatten", False)
+        self._activation = getattr(dense, "_activation", None)
+
+    def forward(self, x):
+        w_q, b = self._w_q, self._bias
+        act_amax, w_amax = self._act_amax, self._w_amax
+        flatten, activation = self._flatten, self._activation
+
+        def closed(xd):
+            if flatten and xd.ndim > 2:
+                xd = xd.reshape(xd.shape[0], -1)
+            x_q = _quantize_sym(xd, act_amax)
+            y = quantized_fully_connected.raw_fn(x_q, w_q, act_amax,
+                                                 w_amax, bias=b)
+            if activation is not None:
+                from ..ops.nn import _act
+                y = _act(y, activation)
+            return y
+
+        return apply_op("QuantizedDense", closed, [x], nodiff=True)
+
+
+def calib_ranges(net, calib_data, layers=None):
+    """Run calibration batches, recording per-Dense input |max| (parity:
+    calibrate.cc minmax mode). Returns {block: amax}.
+
+    Hybridized nets calibrate EAGERLY: hooks must see concrete values,
+    so hybridization is suspended for the calibration pass and restored
+    after (inside a jit trace the hook input would be an abstract
+    tracer)."""
+    ranges = {}
+    handles = []
+    hybrid_flags = []
+
+    def walk(block):
+        if hasattr(block, "_active") and block._active:
+            hybrid_flags.append(block)
+            block._active = False
+        for child in block._children.values():
+            if isinstance(child, Dense) and (layers is None
+                                             or child in layers):
+                def hook(blk, args, _out=None, _b=None):
+                    a = args[0]
+                    amax = float(jnp.max(jnp.abs(a._data)))
+                    ranges[id(blk)] = max(ranges.get(id(blk), 0.0), amax)
+                handles.append(child.register_forward_pre_hook(hook))
+            walk(child)
+
+    walk(net)
+    try:
+        for batch in calib_data:
+            data = batch[0] if isinstance(batch, (tuple, list)) else batch
+            net(data)
+    finally:
+        for h in handles:
+            h.detach()
+        for b in hybrid_flags:
+            b._active = True
+    return ranges
+
+
+def quantize_net(net, calib_data, exclude=None):
+    """Post-training-quantize a net's Dense layers in place (parity:
+    contrib.quantization.quantize_net, minmax calibration). Returns net.
+    Layers in `exclude` (or with <2 dims of weight) stay float."""
+    exclude = set(id(b) for b in (exclude or []))
+    ranges = calib_ranges(net, calib_data)
+
+    def walk(block):
+        for name, child in list(block._children.items()):
+            if isinstance(child, Dense) and id(child) in ranges \
+                    and id(child) not in exclude:
+                setattr(block, name, QuantizedDense(child,
+                                                    ranges[id(child)]))
+            else:
+                walk(child)
+
+    walk(net)
+
+    def clear_caches(block):
+        # hybridized traces captured the float Dense weights; drop them
+        if hasattr(block, "_jit_cache"):
+            block._jit_cache = {}
+            block.__dict__["_hybrid_params"] = None
+        for child in block._children.values():
+            clear_caches(child)
+
+    clear_caches(net)
+    return net

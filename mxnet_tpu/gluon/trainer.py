@@ -121,6 +121,8 @@ class Trainer:
         if self._kvstore_type not in (None, "device", "local"):
             from .. import kvstore as kv
             store = kv.create(self._kvstore_type)
+            if self._compression_params:
+                store.set_gradient_compression(self._compression_params)
             if store.num_workers > 1:
                 self._kvstore = store
         self._kv_initialized = True

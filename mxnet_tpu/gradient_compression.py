@@ -1,0 +1,83 @@
+"""2-bit gradient compression with error feedback.
+
+Reference parity: src/kvstore/gradient_compression.cc — the optional
+2-bit quantizer on dist pushes: values above +threshold quantize to
++threshold, below -threshold to -threshold, else 0; the quantization
+error accumulates in a per-key residual added to the next gradient, so
+small updates are eventually transmitted (error-feedback SGD).
+
+TPU-native notes: quantize/dequantize run on device (jit-fused); the
+wire format packs 16 2-bit codes per uint32 exactly like the reference's
+kernel, so the communicated payload is 1/16 the gradient size. The
+facade kvstore applies it on its host allreduce path; the long-term home
+is quantized XLA collectives (SURVEY.md §5.8, cf. EQuARX)."""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+__all__ = ["TwoBitCompressor"]
+
+
+class TwoBitCompressor:
+    """Stateful per-key 2-bit compressor (residual = error feedback)."""
+
+    def __init__(self, threshold=0.5):
+        t = float(threshold)
+        if t <= 0:
+            raise MXNetError("2bit compression threshold must be > 0")
+        self.threshold = t
+        self._residual = {}
+
+    @staticmethod
+    @jax.jit
+    def _quantize(g, threshold):
+        codes = jnp.where(g >= threshold, 1,
+                          jnp.where(g <= -threshold, 2, 0)).astype(
+            jnp.uint32)
+        n = codes.shape[0]
+        pad = (-n) % 16
+        codes = jnp.pad(codes, (0, pad))
+        codes = codes.reshape(-1, 16)
+        shifts = jnp.arange(16, dtype=jnp.uint32) * 2
+        packed = (codes << shifts[None, :]).sum(axis=1).astype(jnp.uint32)
+        return packed
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def _dequantize_packed(packed, threshold, n):
+        shifts = jnp.arange(16, dtype=jnp.uint32) * 2
+        codes = (packed[:, None] >> shifts[None, :]) & 0x3
+        codes = codes.reshape(-1)[:n]
+        return jnp.where(codes == 1, threshold,
+                         jnp.where(codes == 2, -threshold, 0.0))
+
+    def compress(self, key, grad):
+        """grad (any shape, float) → (packed uint32 wire array). Adds the
+        stored residual first and keeps the new quantization error."""
+        flat = grad.reshape(-1).astype(jnp.float32)
+        res = self._residual.get(key)
+        if res is not None:
+            flat = flat + res
+        packed = self._quantize(flat, self.threshold)
+        deq = self._dequantize_packed(packed, self.threshold,
+                                      flat.shape[0])
+        self._residual[key] = flat - deq
+        return packed
+
+    def decompress(self, packed, shape, dtype=jnp.float32):
+        n = 1
+        for d in shape:
+            n *= d
+        return self._dequantize_packed(
+            packed, self.threshold, n).reshape(shape).astype(dtype)
+
+    def wire_bytes(self, shape):
+        n = 1
+        for d in shape:
+            n *= d
+        return ((n + 15) // 16) * 4

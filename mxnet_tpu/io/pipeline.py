@@ -149,6 +149,9 @@ class ImageRecordIter:
         self.data_shape = tuple(data_shape)   # (3, H, W)
         self._shuffle = shuffle
         self._augs = aug_list or []
+        if num_threads is None:
+            from ..config import get as _cfg
+            num_threads = _cfg("MXTPU_DECODE_THREADS")
         self._threads = num_threads or min(8, os.cpu_count() or 4)
         self._prefetch = max(1, int(prefetch))
         self._seed = seed

@@ -135,7 +135,7 @@ class KVStore:
         return 1
 
     # -- reduction core (overridden by the dist store) --------------------
-    def _allreduce(self, arr):
+    def _allreduce(self, arr, key=None):
         return arr
 
     def _bcast_from_root(self, arr):
@@ -147,14 +147,14 @@ class KVStore:
         NDArray = _nd()
         return v._data if isinstance(v, NDArray) else jnp.asarray(v)
 
-    def _merge(self, value):
+    def _merge(self, value, key=None):
         # a key's value may be one array or a list of per-device arrays
         # (reference: comm reduce across GPUs); sum then cross-process
         datas = [self._data_of(v) for v in _as_list(value)]
         merged = datas[0]
         for d in datas[1:]:
             merged = merged + d
-        return self._allreduce(merged)
+        return self._allreduce(merged, key)
 
     @staticmethod
     def _pairs(key, value):
@@ -175,7 +175,7 @@ class KVStore:
         for k, v in self._pairs(key, value):
             if k not in self._store:
                 raise MXNetError(f"key {k!r} not initialized; call init()")
-            merged = self._merge(v)
+            merged = self._merge(v, k)
             if self._updater is not None:
                 stored = _nd()(self._store[k])
                 self._updater(k, _nd()(merged), stored)
@@ -211,7 +211,7 @@ class KVStore:
                 if k not in self._store:
                     raise MXNetError(
                         f"key {k!r} not initialized; call init()")
-                merged = self._merge(vp[k])
+                merged = self._merge(vp[k], k)
                 self._store[k] = merged
                 for oo in _as_list(o):
                     oo._rebind(merged)
@@ -242,11 +242,31 @@ class KVStore:
         self._updater = self._updater_obj
 
     def set_gradient_compression(self, compression_params):
+        """Parity: kvstore.set_gradient_compression({'type': '2bit',
+        'threshold': t}). Applied on the multi-process reduce path
+        (gradient_compression.TwoBitCompressor — 16x smaller wire
+        payload, error feedback); a single-process store has no wire to
+        compress, so there it only records the setting."""
         self._compression = dict(compression_params or {})
-        warnings.warn(
-            "gradient compression is accepted for API parity but not "
-            "applied: quantized XLA collectives are a planned optimization "
-            "(SURVEY.md §5.8; cf. EQuARX)", stacklevel=2)
+        if not self._compression:
+            self._compressor = None  # explicit disable / no-op
+            return
+        if "type" not in self._compression:
+            raise MXNetError(
+                "compression_params requires a 'type' key (the reference "
+                "rejects it too); use {'type': '2bit', 'threshold': t}")
+        ctype = self._compression["type"]
+        if ctype != "2bit":
+            raise MXNetError(
+                f"unsupported gradient compression type {ctype!r} "
+                "(the reference and this rebuild support '2bit')")
+        from .gradient_compression import TwoBitCompressor
+        self._compressor = TwoBitCompressor(
+            float(self._compression.get("threshold", 0.5)))
+        if self.num_workers == 1:
+            warnings.warn(
+                "gradient compression set on a single-process kvstore: "
+                "nothing to compress (no cross-process wire)", stacklevel=2)
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._optimizer is None:
@@ -284,11 +304,21 @@ class _DistSyncKVStore(KVStore):
     _BIG_WARNED = False
     _BIG_BYTES = 8 << 20
 
-    def _allreduce(self, arr):
+    def _allreduce(self, arr, key=None):
         if self._size == 1:
             return arr
         import jax.numpy as jnp
         from jax.experimental import multihost_utils
+        comp = getattr(self, "_compressor", None)
+        if comp is not None and key is not None and arr.size >= 16:
+            packed = comp.compress(key, arr)
+            gathered = multihost_utils.process_allgather(
+                _np.asarray(packed))          # (P, n_words)
+            total = None
+            for row in gathered:
+                d = comp.decompress(jnp.asarray(row), arr.shape)
+                total = d if total is None else total + d
+            return total.astype(arr.dtype)
         if (not _DistSyncKVStore._BIG_WARNED
                 and arr.size * arr.dtype.itemsize > self._BIG_BYTES):
             _DistSyncKVStore._BIG_WARNED = True

@@ -1,0 +1,134 @@
+"""mx.operator — user-defined operators.
+
+Reference parity: python/mxnet/operator.py (CustomOp/CustomOpProp +
+register, the Python custom-op path running through
+src/operator/custom/custom.cc's dedicated worker thread) and the 1.7+
+C-ABI plugin lib (include/mxnet/lib_api.h). Two registration paths here:
+
+  * `register_op(name, fn, grad=None)` — the MODERN path: fn is a pure
+    jax function; it lands in the global op registry (mx.nd.<name>),
+    tapes like any built-in, jits into hybrid traces, and an optional
+    custom gradient attaches via jax.custom_vjp. User Pallas kernels
+    register the same way — this is the lib_api.h equivalent.
+  * `CustomOp`/`CustomOpProp` + `@register` — the legacy class API for
+    source compatibility: eager-only (the reference's slow GIL path,
+    faithfully), invoked via mx.nd.Custom(..., op_type=name).
+"""
+from __future__ import annotations
+
+import jax
+
+from .base import MXNetError
+from .ops.registry import OPS, apply_op, op as _op_deco
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "register_op", "get"]
+
+_custom_props = {}
+
+
+def register_op(name, fn, grad=None, register_global=True):
+    """Register a pure-jax function as a first-class operator.
+
+    fn(*jax_arrays, **static_kwargs) -> array/tuple. grad: optional
+    (residual-style) custom vjp as (fwd, bwd) pair or None to use jax AD.
+    Returns the wrapped op (also exposed as mx.nd.<name>)."""
+    if grad is not None:
+        fwd, bwd = grad
+        cfn = jax.custom_vjp(fn)
+        cfn.defvjp(fwd, bwd)
+        fn = cfn
+    wrapped = _op_deco(name, register=register_global)(fn)
+    return wrapped
+
+
+class CustomOp:
+    """Base class for legacy custom operators (parity: mx.operator.
+    CustomOp). Subclasses implement forward/backward with assign()."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise MXNetError(
+            f"{type(self).__name__}.backward not implemented; legacy "
+            "CustomOp autograd requires it (or use register_op with "
+            "jax AD)")
+
+    @staticmethod
+    def assign(dst, req, src):
+        """Parity: CustomOp.assign — honor the write/add/null req."""
+        if req in ("null", 0):
+            return
+        if req in ("add", 3):
+            dst._rebind((dst + src)._data)
+        else:
+            dst._rebind(src._data if hasattr(src, "_data") else src)
+
+
+class CustomOpProp:
+    """Parity: mx.operator.CustomOpProp — declares the op's signature."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Parity: mx.operator.register — class decorator on a CustomOpProp."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register() expects a CustomOpProp subclass")
+        _custom_props[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get(reg_name):
+    if reg_name not in _custom_props:
+        raise MXNetError(
+            f"no custom op {reg_name!r} registered "
+            f"(have {sorted(_custom_props)})")
+    return _custom_props[reg_name]
+
+
+def Custom(*data, op_type=None, **kwargs):
+    """Invoke a registered legacy custom op eagerly (parity:
+    mx.nd.Custom). Runs on host Python — the reference's documented slow
+    path; use register_op for the compiled path."""
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    prop = get(op_type)(**kwargs)
+    in_shapes = [tuple(d.shape) for d in data]
+    _, out_shapes, _ = prop.infer_shape(list(in_shapes))
+    operator = prop.create_operator(None, in_shapes,
+                                    [d.dtype for d in data])
+    from .ndarray.ndarray import NDArray
+    from .ops import init as _init
+    outs = [_init.zeros(tuple(s)) for s in out_shapes]
+    operator.forward(False, ["write"] * len(outs), list(data), outs, [])
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+# expose under mx.nd for parity
+def _install_nd_custom():
+    from . import ndarray as nd
+    nd.Custom = Custom
+
+
+_install_nd_custom()

@@ -1,0 +1,55 @@
+"""2-bit gradient compression tests (parity:
+src/kvstore/gradient_compression.cc semantics)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gradient_compression import TwoBitCompressor
+
+
+def test_quantize_roundtrip_and_wire_size():
+    c = TwoBitCompressor(threshold=0.5)
+    g = jnp.asarray([0.7, -0.9, 0.1, -0.2] * 8, jnp.float32)
+    packed = c.compress("k", g)
+    assert packed.dtype == jnp.uint32
+    assert packed.size == 2  # 32 values → 2 uint32 words (16x smaller)
+    assert c.wire_bytes(g.shape) == 8
+    deq = c.decompress(packed, g.shape)
+    np.testing.assert_array_equal(
+        np.asarray(deq), np.asarray([0.5, -0.5, 0.0, 0.0] * 8))
+
+
+def test_error_feedback_transmits_small_gradients():
+    """A gradient below threshold must accumulate in the residual and
+    eventually transmit (error-feedback contract)."""
+    c = TwoBitCompressor(threshold=1.0)
+    g = jnp.full((16,), 0.3, jnp.float32)
+    sent = np.zeros(16, np.float32)
+    for _ in range(10):
+        packed = c.compress("w", g)
+        sent += np.asarray(c.decompress(packed, g.shape))
+    # 10 steps x 0.3 = 3.0 total signal; transmitted total must track it
+    np.testing.assert_allclose(sent, 3.0, atol=1.0)
+
+
+def test_compressor_validates():
+    with pytest.raises(MXNetError):
+        TwoBitCompressor(threshold=0.0)
+    store = mx.kv.create("local")
+    with pytest.raises(MXNetError, match="2bit"):
+        store.set_gradient_compression({"type": "1bit"})
+    with pytest.warns(UserWarning, match="single-process"):
+        store.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+
+
+def test_odd_sizes_pad_correctly():
+    c = TwoBitCompressor(threshold=0.25)
+    g = jnp.asarray(np.linspace(-1, 1, 37), jnp.float32)
+    packed = c.compress("k", g)
+    deq = np.asarray(c.decompress(packed, g.shape))
+    want = np.where(np.linspace(-1, 1, 37) >= 0.25, 0.25,
+                    np.where(np.linspace(-1, 1, 37) <= -0.25, -0.25, 0.0))
+    np.testing.assert_allclose(deq, want)

@@ -13,7 +13,7 @@ def test_advertised_namespaces_import():
                  "init", "lr_scheduler", "kv", "kvstore", "parallel", "io",
                  "recordio", "test_utils", "runtime", "engine", "context",
                  "functional", "models", "amp", "profiler", "image",
-                 "checkpoint"):
+                 "checkpoint", "operator", "config", "contrib"):
         mod = getattr(mx, name)
         assert mod is not None, name
 
