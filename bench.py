@@ -61,20 +61,22 @@ def _emit(metric, value, unit, vs_baseline, extras=None, error=None):
     print(json.dumps(rec))
 
 
-def bench_bert():
+def bench_bert(large=False):
     import jax
     import mxnet_tpu as mx
     from mxnet_tpu import optimizer as opt, parallel as par
     from mxnet_tpu.gluon import loss as gloss
-    from mxnet_tpu.models import BertForMaskedLM, bert_base_config
+    from mxnet_tpu.models import (BertForMaskedLM, bert_base_config,
+                                  bert_large_config)
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", 512))
     n_masked = int(os.environ.get("BENCH_MASKED", 76))
     steps = int(os.environ.get("BENCH_STEPS", 10))
-    cfg = bert_base_config(dtype="bfloat16" if on_tpu else "float32",
-                           dropout=0.1, max_length=seq_len)
+    mk_cfg = bert_large_config if large else bert_base_config
+    cfg = mk_cfg(dtype="bfloat16" if on_tpu else "float32",
+                 dropout=0.1, max_length=seq_len)
     if not on_tpu:  # CPU smoke config so the bench always completes
         cfg.num_layers = 2
         cfg.units, cfg.hidden_size, cfg.num_heads = 128, 512, 2
@@ -82,8 +84,9 @@ def bench_bert():
         n_masked = 20
         steps = 3
 
+    default_batches = "16,8,4" if large else "32,16,8"
     candidates = [int(b) for b in
-                  os.environ.get("BENCH_BATCH", "32,16,8").split(",")]
+                  os.environ.get("BENCH_BATCH", default_batches).split(",")]
     rng = np.random.default_rng(0)
     lfn = gloss.SoftmaxCrossEntropyLoss()
 
@@ -126,8 +129,8 @@ def bench_bert():
             last_err = e
             continue
     else:
-        _emit("bert_base_mlm_mfu", 0.0, "fraction", 0.0,
-              error=str(last_err)[:200])
+        _emit("bert_large_mlm_mfu" if large else "bert_base_mlm_mfu",
+              0.0, "fraction", 0.0, error=str(last_err)[:200])
         return 1
 
     n_params = cfg.num_params()
@@ -138,7 +141,8 @@ def bench_bert():
     achieved = step_flops / dt
     mfu = achieved / peak_flops(dev)
     tokens_per_sec = tokens_per_step / dt
-    _emit("bert_base_mlm_mfu", round(mfu, 4), "fraction",
+    metric = "bert_large_mlm_mfu" if large else "bert_base_mlm_mfu"
+    _emit(metric, round(mfu, 4), "fraction",
           round(mfu / 0.35, 4), extras={
               "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
               "step_time_ms": round(dt * 1e3, 2),
@@ -355,6 +359,8 @@ def main():
         return rc_b or rc_r
     if workload in ("bert", "bert_base"):
         return bench_bert()
+    if workload in ("bert_large",):
+        return bench_bert(large=True)
     if workload in ("resnet", "resnet50", "resnet50_v1b"):
         return bench_resnet50()
     if workload in ("gpt2", "gpt2_decode", "gpt2_774m"):

@@ -85,9 +85,19 @@ class TrainCheckpoint:
         args = {"state": ocp.args.StandardSave(state)}
         if data_cursor is not None:
             args["cursor"] = ocp.args.JsonSave(data_cursor)
-        self._mgr.save(int(step), args=ocp.args.Composite(**args))
-        if wait:
-            self._mgr.wait_until_finished()
+        self._saving = True
+        try:
+            self._mgr.save(int(step), args=ocp.args.Composite(**args))
+            if wait:
+                self._mgr.wait_until_finished()
+        finally:
+            self._saving = False
+
+    @property
+    def save_in_progress(self):
+        """True while a save() call is on the stack (consulted by the
+        preemption handler — CheckpointManager is not reentrant)."""
+        return getattr(self, "_saving", False)
 
     def restore(self, train_step, step=None):
         """Restore into the TrainStep's device buffers (respecting their
@@ -165,9 +175,18 @@ def install_preemption_handler(ckpt, train_step, get_step,
     previous = {}
 
     def handler(signum, frame):
-        ckpt.save(int(get_step()), train_step,
-                  data_cursor=get_cursor() if get_cursor else None,
-                  wait=True)
+        # a signal can land while the main thread is INSIDE ckpt.save /
+        # orbax machinery, which is not reentrant: in that case the
+        # in-flight save is the preemption checkpoint — just wait for it
+        if ckpt.save_in_progress:
+            try:
+                ckpt.wait_until_finished()
+            except Exception:
+                pass
+        else:
+            ckpt.save(int(get_step()), train_step,
+                      data_cursor=get_cursor() if get_cursor else None,
+                      wait=True)
         prev = previous.get(signum)
         _signal.signal(signum, prev if prev is not None else
                        _signal.SIG_DFL)

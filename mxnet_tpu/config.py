@@ -53,8 +53,10 @@ KNOBS = {k.name: k for k in [
     # bench knobs (bench.py)
     Knob("BENCH_WORKLOAD", str, "both",
          "bench.py workload: both|bert|resnet50|gpt2_decode|decode"),
-    Knob("BENCH_BATCH", str, "32,16,8",
-         "bench.py candidate batch sizes, best-effort descending"),
+    Knob("BENCH_BATCH", str, "",
+         "bench.py candidate batch sizes, best-effort descending; empty "
+         "= per-workload default (bert 32,16,8; bert_large 16,8,4; "
+         "resnet50 256,128,64)"),
     Knob("BENCH_STEPS", int, 10, "bench.py timed steps"),
     Knob("BENCH_SEQ_LEN", int, 512, "BERT bench sequence length"),
     Knob("BENCH_MASKED", int, 76, "BERT bench masked positions per row"),

@@ -7,6 +7,8 @@ NDArrayIter shim covers the Module-era API.
 from .recordio import (  # noqa: F401
     IRHeader, MXIndexedRecordIO, MXRecordIO, pack, pack_img, unpack,
     unpack_img)
-from .io import DataBatch, DataDesc, DataIter, NDArrayIter  # noqa: F401
+from .io import (  # noqa: F401
+    DataBatch, DataDesc, DataIter, NDArrayIter, PrefetchingIter,
+    ResizeIter)
 from .pipeline import (  # noqa: F401
     ImageRecordIter, NativeJpegDecoder, decode_jpeg)

@@ -1,9 +1,10 @@
 """Legacy DataIter surface (Module-era API).
 
 Reference parity: python/mxnet/io/io.py — DataIter, DataBatch, DataDesc,
-NDArrayIter (pad/discard/roll_over), ResizeIter/PrefetchingIter are
-de-scoped (gluon.data.DataLoader is the supported pipeline; this shim keeps
-old training scripts importable).
+NDArrayIter (pad/discard/roll_over), ResizeIter (epoch resizing) and
+PrefetchingIter (background-thread double buffering). gluon.data.DataLoader
+and io.pipeline.ImageRecordIter are the supported pipelines; these shims
+keep old training scripts running.
 """
 from __future__ import annotations
 
@@ -14,7 +15,8 @@ import numpy as _np
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 
-__all__ = ["DataIter", "DataBatch", "DataDesc", "NDArrayIter"]
+__all__ = ["DataIter", "DataBatch", "DataDesc", "NDArrayIter",
+           "ResizeIter", "PrefetchingIter"]
 
 DataDesc = namedtuple("DataDesc", ["name", "shape"])
 
@@ -118,3 +120,152 @@ class NDArrayIter(DataIter):
         return DataBatch(data, label, pad=pad,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
+
+
+class ResizeIter(DataIter):
+    """Resize (truncate or repeat) an iterator to `size` batches per epoch
+    (parity: io.ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(getattr(data_iter, "batch_size", 0))
+        self.data_iter = data_iter
+        self.size = int(size)
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self._it = iter(data_iter)
+
+    @property
+    def provide_data(self):
+        return getattr(self.data_iter, "provide_data", None)
+
+    @property
+    def provide_label(self):
+        return getattr(self.data_iter, "provide_label", None)
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+            self._it = iter(self.data_iter)
+
+    def next(self):
+        if self.cur >= self.size:
+            raise StopIteration
+        try:
+            batch = next(self._it)
+        except StopIteration:
+            self.data_iter.reset()
+            self._it = iter(self.data_iter)
+            batch = next(self._it)
+        self.cur += 1
+        return batch
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch over an iterator (parity:
+    io.PrefetchingIter — the double-buffered producer/consumer the
+    reference builds on dmlc threadediter). rename_data/rename_label:
+    [{old: new}] renames applied to the delegated provide_data/label."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch=2):
+        import queue as _queue
+        import threading as _threading
+
+        if isinstance(iters, (list, tuple)):
+            if len(iters) != 1:
+                raise MXNetError(
+                    "PrefetchingIter over multiple iterators is not "
+                    "supported; wrap each separately")
+            iters = iters[0]
+        super().__init__(getattr(iters, "batch_size", 0))
+        self.data_iter = iters
+        self._rename_data = (rename_data[0]
+                             if isinstance(rename_data, list) else
+                             rename_data) or {}
+        self._rename_label = (rename_label[0]
+                              if isinstance(rename_label, list) else
+                              rename_label) or {}
+        self._queue_mod = _queue
+        self._threading = _threading
+        self._prefetch = max(1, int(prefetch))
+        self._thread = None
+        self._start()
+
+    def _renamed(self, descs, renames):
+        if descs is None:
+            return None
+        return [type(d)(renames.get(d.name, d.name), *d[1:]) for d in descs]
+
+    @property
+    def provide_data(self):
+        return self._renamed(getattr(self.data_iter, "provide_data", None),
+                             self._rename_data)
+
+    @property
+    def provide_label(self):
+        return self._renamed(getattr(self.data_iter, "provide_label",
+                                     None), self._rename_label)
+
+    def _start(self):
+        q = self._queue_mod.Queue(maxsize=self._prefetch)
+        stop = self._threading.Event()
+        Full = self._queue_mod.Full
+
+        def put(item):
+            # EVERY producer put is bounded and stop-aware (incl. the
+            # end sentinel and exceptions) so reset()/abandonment can
+            # never leave the thread blocked on a dead queue
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for batch in self.data_iter:
+                    if not put(batch):
+                        return
+                put(None)
+            except Exception as e:
+                put(e)
+
+        self._q = q
+        self._stop = stop
+        self._done = False
+        self._thread = self._threading.Thread(target=produce, daemon=True)
+        self._thread.start()
+
+    def _shutdown(self):
+        if self._thread is None:
+            return
+        self._stop.set()
+        # unblock a producer waiting on a full queue, then join so no
+        # thread still touches data_iter when the caller resets it
+        try:
+            while True:
+                self._q.get_nowait()
+        except self._queue_mod.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+        self._thread = None
+
+    def reset(self):
+        self._shutdown()
+        self.data_iter.reset()
+        self._start()
+
+    def next(self):
+        if self._done:
+            raise StopIteration  # keep raising until reset (reference)
+        item = self._q.get()
+        if item is None:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, Exception):
+            self._done = True
+            raise item
+        return item

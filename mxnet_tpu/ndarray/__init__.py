@@ -29,3 +29,20 @@ def _populate():
 
 _populate()
 del _populate
+
+
+def __getattr__(name):
+    # ops registered AFTER import (operator.register_op, user plugins)
+    # still resolve as mx.nd.<name>, like the reference's registry-backed
+    # stub generation; mx.nd.Custom resolves the legacy custom-op entry
+    if name == "Custom":
+        from ..operator import Custom
+        globals()["Custom"] = Custom
+        return Custom
+    try:
+        fn = OPS.get(name)
+    except Exception:
+        raise AttributeError(
+            f"module 'mxnet_tpu.ndarray' has no attribute {name!r}")
+    globals()[name] = fn
+    return fn

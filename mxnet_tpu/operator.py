@@ -31,7 +31,9 @@ def register_op(name, fn, grad=None, register_global=True):
 
     fn(*jax_arrays, **static_kwargs) -> array/tuple. grad: optional
     (residual-style) custom vjp as (fwd, bwd) pair or None to use jax AD.
-    Returns the wrapped op (also exposed as mx.nd.<name>)."""
+    Returns the wrapped op; with register_global it also resolves as
+    mx.nd.<name> (the ndarray namespace consults the registry on
+    attribute miss)."""
     if grad is not None:
         fwd, bwd = grad
         cfn = jax.custom_vjp(fn)
@@ -125,10 +127,4 @@ def Custom(*data, op_type=None, **kwargs):
     return outs[0] if len(outs) == 1 else tuple(outs)
 
 
-# expose under mx.nd for parity
-def _install_nd_custom():
-    from . import ndarray as nd
-    nd.Custom = Custom
-
-
-_install_nd_custom()
+# mx.nd.Custom resolves through mxnet_tpu.ndarray.__getattr__

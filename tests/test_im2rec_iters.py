@@ -1,0 +1,97 @@
+"""im2rec CLI + ResizeIter/PrefetchingIter tests (parity: tools/im2rec.py
+and io.ResizeIter/PrefetchingIter)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import NDArrayIter, PrefetchingIter, ResizeIter
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+
+def _folder(tmp_path, classes=("cat", "dog"), per_class=3):
+    import cv2
+    root = tmp_path / "imgs"
+    r = np.random.default_rng(0)
+    for c in classes:
+        (root / c).mkdir(parents=True)
+        for i in range(per_class):
+            img = r.integers(0, 255, (20, 24, 3)).astype(np.uint8)
+            cv2.imwrite(str(root / c / f"{i}.jpg"), img)
+    return str(root)
+
+
+def test_im2rec_end_to_end(tmp_path):
+    import im2rec
+    root = _folder(tmp_path)
+    prefix = str(tmp_path / "pack")
+    rc = im2rec.main([prefix, root, "--recursive", "--resize", "16"])
+    assert rc == 0
+    assert os.path.exists(prefix + ".lst")
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+    # the pack feeds the high-throughput iterator directly
+    from mxnet_tpu.io import ImageRecordIter
+    it = ImageRecordIter(prefix + ".rec", batch_size=3,
+                         data_shape=(3, 16, 16), to_device=False)
+    data, label = next(iter(it))
+    assert data.shape == (3, 3, 16, 16)
+    assert set(np.unique(label)).issubset({0.0, 1.0})
+    # .lst round trip
+    items = im2rec.read_lst(prefix + ".lst")
+    assert len(items) == 6
+    labels = {lab for _, lab, _ in items}
+    assert labels == {0.0, 1.0}
+
+
+def _nditer(n=10, bs=2):
+    x = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+    y = np.arange(n, dtype=np.float32)
+    return NDArrayIter(data=x, label=y, batch_size=bs)
+
+
+def test_resize_iter_truncates_and_repeats():
+    it = ResizeIter(_nditer(), 3)
+    assert len(list(it)) == 3
+    it.reset()
+    assert len(list(it)) == 3
+    # size larger than the underlying epoch → wraps around
+    it = ResizeIter(_nditer(), 8)
+    assert len(list(it)) == 8
+
+
+def test_prefetching_iter_post_exhaustion_and_delegation():
+    pre = PrefetchingIter(_nditer(), rename_data=[{"data": "x"}])
+    list(pre)
+    with pytest.raises(StopIteration):  # keeps raising, never hangs
+        pre.next()
+    with pytest.raises(StopIteration):
+        pre.next()
+    pd = pre.provide_data
+    assert pd and pd[0].name == "x"  # renamed delegation
+    assert ResizeIter(_nditer(), 2).provide_data is not None
+
+
+def test_nd_resolves_late_registered_ops():
+    import mxnet_tpu.operator as mxop
+    mxop.register_op("late_double", lambda x: x * 2)
+    out = mx.nd.late_double(mx.nd.array([3.0]))
+    np.testing.assert_allclose(out.asnumpy(), [6.0])
+    with pytest.raises(AttributeError):
+        mx.nd.definitely_not_an_op
+
+
+def test_prefetching_iter_matches_plain():
+    plain = [b.data[0].asnumpy() for b in _nditer()]
+    pre = PrefetchingIter(_nditer())
+    got = [b.data[0].asnumpy() for b in pre]
+    assert len(got) == len(plain)
+    for a, b in zip(got, plain):
+        np.testing.assert_array_equal(a, b)
+    pre.reset()
+    again = [b.data[0].asnumpy() for b in pre]
+    assert len(again) == len(plain)

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""im2rec — build RecordIO image packs.
+
+Reference parity: tools/im2rec.py (SURVEY.md §1 Tooling/CLI row): turn an
+image folder (or a .lst index file) into a .rec pack consumable by
+io.ImageRecordIter / ImageRecordDataset. Supports the reference's two
+modes:
+
+  python tools/im2rec.py prefix folder --recursive      # make .lst + .rec
+  python tools/im2rec.py prefix.lst folder              # pack existing .lst
+
+.lst format (reference tab-separated): index \t label \t relative_path
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+IMAGE_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def list_images(root, recursive):
+    cats = {}
+    items = []
+    if recursive:
+        for dirpath in sorted(
+                d for d, _, _ in os.walk(root) if d != root):
+            label_name = os.path.relpath(dirpath, root)
+            for fname in sorted(os.listdir(dirpath)):
+                if os.path.splitext(fname)[1].lower() in IMAGE_EXTS:
+                    lab = cats.setdefault(label_name, len(cats))
+                    items.append((os.path.join(label_name, fname), lab))
+    else:
+        for fname in sorted(os.listdir(root)):
+            if os.path.splitext(fname)[1].lower() in IMAGE_EXTS:
+                items.append((fname, 0))
+    return items, cats
+
+
+def write_lst(path, items):
+    with open(path, "w") as f:
+        for i, (rel, lab) in enumerate(items):
+            f.write(f"{i}\t{lab}\t{rel}\n")
+
+
+def read_lst(path):
+    items = []
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx, label, rel = parts[0], parts[1], "\t".join(parts[2:])
+            items.append((int(idx), float(label), rel))
+    return items
+
+
+def make_rec(prefix, root, items, resize=0, quality=95, center_crop=False):
+    import cv2
+    from mxnet_tpu.io import IRHeader, MXIndexedRecordIO, pack
+
+    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n_ok = 0
+    for idx, label, rel in items:
+        path = os.path.join(root, rel)
+        img = cv2.imread(path)
+        if img is None:
+            print(f"skip unreadable {path}", file=sys.stderr)
+            continue
+        if center_crop and img.shape[0] != img.shape[1]:
+            s = min(img.shape[:2])
+            y0 = (img.shape[0] - s) // 2
+            x0 = (img.shape[1] - s) // 2
+            img = img[y0:y0 + s, x0:x0 + s]
+        if resize:
+            h, w = img.shape[:2]
+            if h < w:
+                nh, nw = resize, int(w * resize / h)
+            else:
+                nh, nw = int(h * resize / w), resize
+            img = cv2.resize(img, (nw, nh))
+        ok, buf = cv2.imencode(".jpg", img,
+                               [cv2.IMWRITE_JPEG_QUALITY, quality])
+        if not ok:
+            print(f"skip unencodable {path}", file=sys.stderr)
+            continue
+        rec.write_idx(idx, pack(IRHeader(0, label, idx, 0),
+                                bytes(buf.tobytes())))
+        n_ok += 1
+    rec.close()
+    return n_ok
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix", help="output prefix, or an existing .lst file")
+    p.add_argument("root", help="image folder")
+    p.add_argument("--recursive", action="store_true",
+                   help="subfolder names become labels")
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter edge to this (0 = keep)")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--center-crop", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.prefix.endswith(".lst"):
+        items = read_lst(args.prefix)
+        prefix = args.prefix[:-4]
+    else:
+        listed, cats = list_images(args.root, args.recursive)
+        prefix = args.prefix
+        write_lst(prefix + ".lst", listed)
+        items = [(i, float(lab), rel)
+                 for i, (rel, lab) in enumerate(listed)]
+        if cats:
+            print("labels:", {v: k for k, v in sorted(
+                cats.items(), key=lambda kv: kv[1])})
+    n = make_rec(prefix, args.root, items, resize=args.resize,
+                 quality=args.quality, center_crop=args.center_crop)
+    print(f"wrote {n} records to {prefix}.rec")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
