@@ -85,8 +85,8 @@ def bench_bert(large=False):
         steps = 3
 
     default_batches = "16,8,4" if large else "32,16,8"
-    candidates = [int(b) for b in
-                  os.environ.get("BENCH_BATCH", default_batches).split(",")]
+    candidates = [int(b) for b in (os.environ.get("BENCH_BATCH")
+                                   or default_batches).split(",")]
     rng = np.random.default_rng(0)
     lfn = gloss.SoftmaxCrossEntropyLoss()
 
@@ -171,8 +171,8 @@ def bench_resnet50():
     steps = int(os.environ.get("BENCH_STEPS", 10))
     image_size = int(os.environ.get("BENCH_IMAGE_SIZE", 224))
     classes = 1000
-    candidates = [int(b) for b in
-                  os.environ.get("BENCH_BATCH", "256,128,64").split(",")]
+    candidates = [int(b) for b in (os.environ.get("BENCH_BATCH")
+                                   or "256,128,64").split(",")]
     if not on_tpu:  # CPU smoke config
         candidates, steps, image_size, classes = [8], 2, 64, 100
 

@@ -92,6 +92,13 @@ class TrainCheckpoint:
                 self._mgr.wait_until_finished()
         finally:
             self._saving = False
+        # a preemption signal that landed MID-save deferred itself here
+        # (re-entering orbax from the signal frame is unsafe); the save
+        # that just completed is the preemption checkpoint
+        pending = getattr(self, "_preempt_pending", None)
+        if pending is not None:
+            self._preempt_pending = None
+            pending()
 
     @property
     def save_in_progress(self):
@@ -174,23 +181,32 @@ def install_preemption_handler(ckpt, train_step, get_step,
     signals = signals or [_signal.SIGTERM]
     previous = {}
 
-    def handler(signum, frame):
-        # a signal can land while the main thread is INSIDE ckpt.save /
-        # orbax machinery, which is not reentrant: in that case the
-        # in-flight save is the preemption checkpoint — just wait for it
-        if ckpt.save_in_progress:
-            try:
-                ckpt.wait_until_finished()
-            except Exception:
-                pass
-        else:
-            ckpt.save(int(get_step()), train_step,
-                      data_cursor=get_cursor() if get_cursor else None,
-                      wait=True)
+    def finish(signum):
         prev = previous.get(signum)
         _signal.signal(signum, prev if prev is not None else
                        _signal.SIG_DFL)
         _signal.raise_signal(signum)
+
+    def handler(signum, frame):
+        # a signal can land while the main thread is INSIDE ckpt.save /
+        # orbax machinery, which is not reentrant — and the interrupted
+        # save frame is suspended UNDER this handler, so calling save
+        # here would re-enter it. Defer: the in-flight save completes
+        # when the handler returns, then save()'s epilogue finishes the
+        # preemption (wait for durability + re-raise).
+        if ckpt.save_in_progress:
+            def deferred():
+                try:
+                    ckpt.wait_until_finished()
+                except Exception:
+                    pass
+                finish(signum)
+            ckpt._preempt_pending = deferred
+            return
+        ckpt.save(int(get_step()), train_step,
+                  data_cursor=get_cursor() if get_cursor else None,
+                  wait=True)
+        finish(signum)
 
     for s in signals:
         previous[s] = _signal.signal(s, handler)

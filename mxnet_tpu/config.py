@@ -52,7 +52,8 @@ KNOBS = {k.name: k for k in [
          "io.ImageRecordIter decode thread count (0 = min(8, cores))"),
     # bench knobs (bench.py)
     Knob("BENCH_WORKLOAD", str, "both",
-         "bench.py workload: both|bert|resnet50|gpt2_decode|decode"),
+         "bench.py workload: both|bert|bert_large|resnet50|gpt2_decode|"
+         "decode"),
     Knob("BENCH_BATCH", str, "",
          "bench.py candidate batch sizes, best-effort descending; empty "
          "= per-workload default (bert 32,16,8; bert_large 16,8,4; "

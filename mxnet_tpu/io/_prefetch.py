@@ -1,0 +1,18 @@
+"""Shared producer/consumer plumbing for the prefetching iterators
+(io.PrefetchingIter, pipeline.ImageRecordIter)."""
+from __future__ import annotations
+
+import queue as _queue
+
+
+def bounded_put(q, stop, item, timeout=0.1):
+    """Queue put that re-checks `stop` instead of blocking forever: an
+    abandoned consumer (early break / reset) must never leave a producer
+    thread wedged on a full queue. Returns False when stopped."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=timeout)
+            return True
+        except _queue.Full:
+            continue
+    return False

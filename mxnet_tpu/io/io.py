@@ -208,21 +208,16 @@ class PrefetchingIter(DataIter):
                                      None), self._rename_label)
 
     def _start(self):
+        from ._prefetch import bounded_put
+
         q = self._queue_mod.Queue(maxsize=self._prefetch)
         stop = self._threading.Event()
-        Full = self._queue_mod.Full
 
         def put(item):
             # EVERY producer put is bounded and stop-aware (incl. the
             # end sentinel and exceptions) so reset()/abandonment can
             # never leave the thread blocked on a dead queue
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except Full:
-                    continue
-            return False
+            return bounded_put(q, stop, item)
 
         def produce():
             try:
@@ -251,6 +246,12 @@ class PrefetchingIter(DataIter):
         except self._queue_mod.Empty:
             pass
         self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            # the underlying iterator is blocked >5s; resetting it under
+            # a live producer would corrupt its state — fail loudly
+            raise MXNetError(
+                "PrefetchingIter producer did not stop within 5s (the "
+                "wrapped iterator is blocked); cannot reset safely")
         self._thread = None
 
     def reset(self):

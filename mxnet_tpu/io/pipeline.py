@@ -210,17 +210,12 @@ class ImageRecordIter:
         q = queue.Queue(maxsize=self._prefetch)
         stop = threading.Event()
 
+        from ._prefetch import bounded_put
+
         def put(item):
-            # bounded put that re-checks stop: an abandoned consumer (early
-            # break) must not leave the producer blocked forever on a full
-            # queue (which would leak this thread + the pool per epoch)
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
+            # abandoned consumers (early break) must not leave the
+            # producer blocked on a full queue (thread + pool leak)
+            return bounded_put(q, stop, item)
 
         def produce():
             try:
