@@ -284,6 +284,62 @@ def bench_gpt2_decode():
     return 0
 
 
+def bench_longcontext():
+    """Long-context attention: fwd+bwd through the blockwise flash path
+    at sequence lengths whose (T, T) score matrix would not fit
+    materialized (SURVEY.md §5.7 — long context is first-class). Emits
+    tokens/sec for one attention layer fwd+bwd at BENCH_LONG_T."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import flash_attention_data
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    T = int(os.environ.get("BENCH_LONG_T", 8192 if on_tpu else 1024))
+    B, H, D = 1, 12, 64
+    steps = int(os.environ.get("BENCH_STEPS", 10)) if on_tpu else 2
+    rng = np.random.default_rng(0)
+    dt_ = jnp.bfloat16 if on_tpu else jnp.float32
+    q = jnp.asarray(rng.standard_normal((B, H, T, D)), dt_)
+    k = jnp.asarray(rng.standard_normal((B, H, T, D)), dt_)
+    v = jnp.asarray(rng.standard_normal((B, H, T, D)), dt_)
+
+    @jax.jit
+    def fwd_bwd(q, k, v):
+        def f(q, k, v):
+            return flash_attention_data(q, k, v, causal=True).astype(
+                jnp.float32).sum()
+        l, g = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+        return l, g
+
+    def sync(l, g):
+        # fetching the loss alone would NOT force the backward (async
+        # dispatch; the loss is produced before the cotangents) — fetch a
+        # gradient element too
+        float(l)
+        float(g[0][0, 0, 0, 0])
+
+    out = fwd_bwd(q, k, v)
+    sync(*out)  # compile + warmup
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fwd_bwd(q, k, v)
+    sync(*out)
+    dt = (time.perf_counter() - t0) / steps
+    # causal attention fwd+bwd ≈ 3.5 * 4 * B*H*T^2*D flops (half masked)
+    flops = 3.5 * 2 * B * H * T * T * D
+    _emit("longcontext_attention_tokens_per_sec", round(B * T / dt, 1),
+          "tokens/sec", 0.0, extras={
+              "seq_len": T, "heads": H, "head_dim": D,
+              "step_time_ms": round(dt * 1e3, 2),
+              "achieved_tflops": round(flops / dt / 1e12, 2),
+              "kernel": "flash (blockwise, O(T) memory)",
+              "device": str(dev.device_kind),
+              "baseline": "reference max practical seq len was 512-1024 "
+                          "(SURVEY.md §5.7: it has no long-context path)"})
+    return 0
+
+
 def bench_decode():
     """Data-pipeline decode throughput (img/sec through ImageRecordIter's
     native libjpeg path — the reference's iter_image_recordio_2.cc role,
@@ -367,6 +423,8 @@ def main():
         return bench_gpt2_decode()
     if workload == "decode":
         return bench_decode()
+    if workload in ("longcontext", "long"):
+        return bench_longcontext()
     _emit("unknown_workload", 0.0, "none", 0.0, error=workload)
     return 1
 

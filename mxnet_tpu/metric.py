@@ -389,3 +389,85 @@ def np_metric(name=None, allow_extra_outputs=False):
         return CustomMetric(f, name or f.__name__, allow_extra_outputs)
 
     return deco
+
+
+class BLEU(EvalMetric):
+    """Corpus BLEU-N with brevity penalty (the NMT-workload metric; the
+    reference keeps BLEU in GluonNLP — provided natively here since
+    transformer NMT is an in-repo model family).
+
+    update(labels, preds): labels = reference token sequences, preds =
+    hypothesis token sequences — lists of int lists / 1-D arrays (or 2-D
+    padded arrays; `ignore` tokens, e.g. PAD/EOS, are stripped). Standard
+    smoothing: none (matches multi-bleu.perl); corpus-level statistics
+    accumulate across update calls."""
+
+    def __init__(self, max_n=4, ignore=(), name="bleu", **kwargs):
+        super().__init__(name, **kwargs)
+        self._max_n = int(max_n)
+        self._ignore = set(int(t) for t in ignore)
+        self.reset()
+
+    def reset(self):
+        self._match = [0] * getattr(self, "_max_n", 4)
+        self._total = [0] * getattr(self, "_max_n", 4)
+        self._hyp_len = 0
+        self._ref_len = 0
+        # EvalMetric bookkeeping (get() is overridden but keep the
+        # base-contract fields consistent)
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def _clean(self, seq):
+        seq = [int(t) for t in _np.asarray(seq).reshape(-1)]
+        return [t for t in seq if t not in self._ignore]
+
+    @staticmethod
+    def _ngrams(seq, n):
+        counts = {}
+        for i in range(len(seq) - n + 1):
+            key = tuple(seq[i:i + n])
+            counts[key] = counts.get(key, 0) + 1
+        return counts
+
+    def update(self, labels, preds):
+        # the whole argument is the batch: a 2-D array, a list of
+        # sequences, or one flat sequence
+        def rows(x):
+            if isinstance(x, (list, tuple)):
+                if x and _np.isscalar(x[0]):
+                    return [x]          # one flat sentence
+                return list(x)          # list of sentences
+            a = _asnumpy(x)
+            return list(a) if a.ndim == 2 else [a]
+
+        ref_rows, hyp_rows = rows(labels), rows(preds)
+        if len(ref_rows) != len(hyp_rows):
+            raise MXNetError(
+                f"BLEU.update: {len(ref_rows)} references vs "
+                f"{len(hyp_rows)} hypotheses")
+        for ref, hyp in zip(ref_rows, hyp_rows):
+            ref = self._clean(ref)
+            hyp = self._clean(hyp)
+            self._hyp_len += len(hyp)
+            self._ref_len += len(ref)
+            for n in range(1, self._max_n + 1):
+                h = self._ngrams(hyp, n)
+                r = self._ngrams(ref, n)
+                self._match[n - 1] += sum(
+                    min(c, r.get(g, 0)) for g, c in h.items())
+                self._total[n - 1] += max(len(hyp) - n + 1, 0)
+            self.num_inst += 1
+
+    def get(self):
+        import math
+        if self.num_inst == 0 or self._hyp_len == 0:
+            return self.name, float("nan")
+        log_p = 0.0
+        for m, t in zip(self._match, self._total):
+            if m == 0 or t == 0:
+                return self.name, 0.0
+            log_p += math.log(m / t)
+        log_p /= self._max_n
+        bp = min(1.0, math.exp(1.0 - self._ref_len / self._hyp_len))
+        return self.name, bp * math.exp(log_p)

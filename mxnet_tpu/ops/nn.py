@@ -686,14 +686,16 @@ def _ring_auto_ok(q, k, mask, train_drop):
 @op("dot_product_attention")
 def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
                           dropout_p=0.0, impl="auto"):
-    """q,k,v: (B, H, T, D). impl: 'auto'|'xla'|'fused'|'flash'|'ring'.
+    """q,k,v: (B, H, T, D). impl:
+    'auto'|'xla'|'fused'|'flash'|'ring'|'ulysses'.
 
     'fused' is the Pallas TPU kernel (ops/pallas_attention.py): whole-row
     softmax→dropout→PV in VMEM with the dropout mask drawn from the
     on-core hardware PRNG — the hot path for T <= 1024 (BERT/GPT-2
     shapes), with or without dropout. 'flash' is the blockwise O(T)
-    kernel in ops/attention.py for long sequences; 'ring' the
-    sequence-parallel path. 'auto' picks ring whenever the active mesh
+    kernel in ops/attention.py for long sequences; 'ring' and 'ulysses'
+    the sequence-parallel paths (ppermute KV rotation vs head
+    all-to-all; parallel/sp.py). 'auto' picks ring whenever the active mesh
     has a real sp axis and shapes/dropout allow (so sequence parallelism
     needs no model-code changes), else fused on TPU when shapes allow,
     flash for long no-dropout sequences, else one XLA softmax-attention.
@@ -704,18 +706,21 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
     train_drop = dropout_p > 0 and is_training()
     if impl == "auto" and _ring_auto_ok(q, k, mask, train_drop):
         impl = "ring"
-    if impl == "ring":
-        # sequence-parallel path: T sharded over the mesh's "sp" axis,
-        # KV blocks rotating via ppermute (parallel/sp.py; SURVEY.md §5.7)
+    if impl in ("ring", "ulysses"):
+        # sequence-parallel paths: T sharded over the mesh's "sp" axis —
+        # ring rotates KV via ppermute (O(T_local) memory); ulysses
+        # all-to-alls to head sharding (2 collectives, full-T scores).
+        # parallel/sp.py; SURVEY.md §5.7.
         from ..parallel import sp as _sp
         if train_drop:
             raise MXNetError(
-                "impl='ring' does not support attention-probability "
+                f"impl={impl!r} does not support attention-probability "
                 "dropout (the mask would need to be consistent across "
-                "ring hops); set attention dropout to 0 under sequence "
+                "devices); set attention dropout to 0 under sequence "
                 "parallelism")
-        return _sp.ring_attention(q, k, v, mask=mask, causal=causal,
-                                  scale=scale)
+        fn = _sp.ring_attention if impl == "ring" \
+            else _sp.ulysses_attention
+        return fn(q, k, v, mask=mask, causal=causal, scale=scale)
     if impl in ("auto", "fused"):
         from . import pallas_attention as _pa
         on_tpu = _target_platform(q) == "tpu"

@@ -11,7 +11,7 @@ from .mesh import (  # noqa: F401
 from .rules import (  # noqa: F401
     ShardingRules, apply_sharding_rules, ep_rules, fsdp_rules,
     megatron_dense_rules)
-from .sp import ring_attention, sp_enabled  # noqa: F401
+from .sp import ring_attention, sp_enabled, ulysses_attention  # noqa: F401
 from .pp import gpipe, stack_stage_params  # noqa: F401
 from .moe import (  # noqa: F401
     all_to_all_tokens, moe_dispatch_combine, top_k_gating)

@@ -20,7 +20,7 @@ from ..base import MXNetError
 from ..ops.attention import ring_attention_data
 from .mesh import AXIS_SP, current_mesh, shard_map_compat
 
-__all__ = ["ring_attention", "sp_enabled"]
+__all__ = ["ring_attention", "ulysses_attention", "sp_enabled"]
 
 
 def sp_enabled(mesh=None, sp_axis=AXIS_SP):
@@ -28,6 +28,39 @@ def sp_enabled(mesh=None, sp_axis=AXIS_SP):
     mesh = mesh if mesh is not None else current_mesh()
     return (mesh is not None and sp_axis in mesh.axis_names
             and mesh.shape[sp_axis] > 1)
+
+
+
+
+def _sp_operands(q, k, v, mask, mesh, sp_axis, batch_axis, heads_axis,
+                 kind):
+    """Shared validation + spec/arg assembly for the SP attention paths.
+
+    Returns (n_sp, ba, ha, qspec, in_specs, args) — args has the
+    canonical (B, Tk) mask appended when one was given."""
+    if mesh is None or sp_axis not in mesh.axis_names:
+        raise MXNetError(
+            f"{kind} attention needs an active mesh with a {sp_axis!r} "
+            "axis (make_mesh(sp=...) + mesh_scope/set_default_mesh)")
+    n_sp = mesh.shape[sp_axis]
+    B, H, T, D = q.shape
+    if T % n_sp or k.shape[-2] % n_sp:
+        raise MXNetError(
+            f"sequence length {T}/{k.shape[-2]} not divisible by sp axis "
+            f"size {n_sp}")
+    ba = batch_axis if batch_axis in mesh.axis_names else None
+    ha = heads_axis if heads_axis in mesh.axis_names else None
+    qspec = P(ba, ha, sp_axis, None)
+    in_specs = [qspec, qspec, qspec]
+    args = [q, k, v]
+    if mask is not None:
+        import jax.numpy as jnp
+        mask2 = mask.reshape(mask.shape[0], mask.shape[-1])
+        if mask2.shape[0] != B:  # broadcastable (1, Tk) masks
+            mask2 = jnp.broadcast_to(mask2, (B, mask2.shape[-1]))
+        in_specs.append(P(ba, sp_axis))
+        args.append(mask2)
+    return n_sp, ba, ha, qspec, in_specs, args
 
 
 def ring_attention(q, k, v, mask=None, causal=False, scale=None, mesh=None,
@@ -41,29 +74,9 @@ def ring_attention(q, k, v, mask=None, causal=False, scale=None, mesh=None,
     (B, Tk) or (B, 1, 1, Tk), True = attend.
     """
     mesh = mesh if mesh is not None else current_mesh()
-    if mesh is None or sp_axis not in mesh.axis_names:
-        raise MXNetError(
-            f"ring attention needs an active mesh with a {sp_axis!r} axis "
-            "(make_mesh(sp=...) + mesh_scope/set_default_mesh)")
-    n_sp = mesh.shape[sp_axis]
-    B, H, T, D = q.shape
-    if T % n_sp or k.shape[-2] % n_sp:
-        raise MXNetError(
-            f"sequence length {T}/{k.shape[-2]} not divisible by sp axis "
-            f"size {n_sp}")
-    ba = batch_axis if batch_axis in mesh.axis_names else None
-    ha = heads_axis if heads_axis in mesh.axis_names else None
-    qspec = P(ba, ha, sp_axis, None)
-    in_specs = [qspec, qspec, qspec]
-    args = [q, k, v]
+    n_sp, ba, ha, qspec, in_specs, args = _sp_operands(
+        q, k, v, mask, mesh, sp_axis, batch_axis, heads_axis, "ring")
     if mask is not None:
-        mask2 = mask.reshape(mask.shape[0], mask.shape[-1])
-        if mask2.shape[0] != B:  # broadcastable (1, Tk) masks
-            import jax.numpy as jnp
-            mask2 = jnp.broadcast_to(mask2, (B, mask2.shape[-1]))
-        in_specs.append(P(ba, sp_axis))
-        args.append(mask2)
-
         def local(qb, kb, vb, mb):
             return ring_attention_data(qb, kb, vb, sp_axis, causal=causal,
                                        scale=scale, mask=mb)
@@ -71,6 +84,71 @@ def ring_attention(q, k, v, mask=None, causal=False, scale=None, mesh=None,
         def local(qb, kb, vb):
             return ring_attention_data(qb, kb, vb, sp_axis, causal=causal,
                                        scale=scale)
+
+    fn = shard_map_compat(local, mesh=mesh, in_specs=tuple(in_specs),
+                          out_specs=qspec, check_rep=False)
+    return fn(*args)
+
+
+def ulysses_attention(q, k, v, mask=None, causal=False, scale=None,
+                      mesh=None, sp_axis=AXIS_SP, batch_axis="dp",
+                      heads_axis="tp"):
+    """Ulysses-style sequence parallelism (DeepSpeed-Ulysses; SURVEY.md
+    §5.7's 'attention-head all-to-all' alternative to the ring).
+
+    Operands enter sharded along T over `sp_axis` exactly like
+    ring_attention (batch over `batch_axis`, heads over `heads_axis`
+    when those mesh axes exist — the megatron activation layout); inside
+    the shard_map an all-to-all re-shards them HEAD-wise (each sp device
+    gets local_H/n_sp heads with the FULL sequence), plain full
+    attention runs locally, and a second all-to-all restores the
+    T-sharded layout. Two collectives total per call vs the ring's
+    n_sp ppermutes — the better trade for moderate context where the
+    full (T, T) score matrix still fits; the ring remains the
+    O(T_local)-memory choice for very long T. The per-device head count
+    (H, or H/tp under tensor parallelism) must divide by the sp size.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops import nn as _opnn
+
+    mesh = mesh if mesh is not None else current_mesh()
+    n_sp, ba, ha, qspec, in_specs, args = _sp_operands(
+        q, k, v, mask, mesh, sp_axis, batch_axis, heads_axis, "ulysses")
+    H = q.shape[1]
+    n_ha = mesh.shape[ha] if ha is not None else 1
+    if H % n_ha or (H // n_ha) % n_sp:
+        raise MXNetError(
+            f"ulysses needs per-device heads {H}/{n_ha} divisible by sp "
+            f"axis size {n_sp}; use ring_attention otherwise")
+
+    def local(*xs):
+        if mask is not None:
+            qb, kb, vb, mb = xs
+        else:
+            qb, kb, vb = xs
+            mb = None
+        # (B, H_local, T/n, D) → all-to-all → (B, H_local/n, T, D):
+        # scatter heads (axis 1), gather sequence (axis 2)
+        def a2a_fwd(x):
+            return lax.all_to_all(x, sp_axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        def a2a_bwd(x):
+            return lax.all_to_all(x, sp_axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        qf, kf, vf = a2a_fwd(qb), a2a_fwd(kb), a2a_fwd(vb)
+        full_mask = None
+        if mb is not None:
+            # key mask is T-sharded; every device needs the full T
+            full_mask = lax.all_gather(mb, sp_axis, axis=1,
+                                       tiled=True)[:, None, None, :]
+        out = _opnn.dot_product_attention.raw_fn(
+            qf, kf, vf, mask=full_mask, causal=causal, scale=scale,
+            impl="xla")
+        return a2a_bwd(out)
 
     fn = shard_map_compat(local, mesh=mesh, in_specs=tuple(in_specs),
                           out_specs=qspec, check_rep=False)

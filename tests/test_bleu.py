@@ -1,0 +1,70 @@
+"""BLEU metric tests (oracle: hand-computed corpus BLEU)."""
+import math
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.metric import BLEU
+
+
+def test_perfect_match_is_one():
+    m = BLEU()
+    m.update([[1, 2, 3, 4, 5]], [[1, 2, 3, 4, 5]])
+    name, v = m.get()
+    assert name == "bleu"
+    np.testing.assert_allclose(v, 1.0)
+
+
+def test_known_value():
+    # hyp: [1,2,3,4], ref: [1,2,3,5]
+    # 1-gram 3/4; 2-gram 2/3; 3-gram 1/2; 4-gram 0 → BLEU 0 (no smoothing)
+    m = BLEU()
+    m.update([[1, 2, 3, 5]], [[1, 2, 3, 4]])
+    assert m.get()[1] == 0.0
+    # with max_n=3: exp(mean(log(3/4), log(2/3), log(1/2))), bp=1
+    m = BLEU(max_n=3)
+    m.update([[1, 2, 3, 5]], [[1, 2, 3, 4]])
+    want = math.exp((math.log(3 / 4) + math.log(2 / 3) +
+                     math.log(1 / 2)) / 3)
+    np.testing.assert_allclose(m.get()[1], want, rtol=1e-9)
+
+
+def test_brevity_penalty_and_corpus_accumulation():
+    m = BLEU(max_n=1)
+    m.update([[1, 2, 3, 4]], [[1, 2]])  # short hyp: bp = exp(1-4/2)
+    np.testing.assert_allclose(m.get()[1], math.exp(1 - 2.0), rtol=1e-9)
+    # second sentence accumulates corpus-level (not averaged per-sentence)
+    m.update([[5, 6]], [[5, 6]])
+    # matches 4/4, hyp_len 4, ref_len 6 → bp = exp(1-6/4)
+    np.testing.assert_allclose(m.get()[1], math.exp(1 - 6 / 4), rtol=1e-9)
+
+
+def test_padded_batch_and_ignore():
+    # stripped sentences are 3 and 2 tokens — use max_n=2 so n-gram
+    # totals are nonzero
+    m = BLEU(max_n=2, ignore=(0, 3))  # PAD=0, EOS=3
+    labels = np.array([[7, 8, 9, 3, 0], [4, 5, 3, 0, 0]])
+    preds = np.array([[7, 8, 9, 3, 0], [4, 5, 3, 0, 0]])
+    m.update(labels, preds)
+    np.testing.assert_allclose(m.get()[1], 1.0)
+
+
+def test_list_of_sequences_batch():
+    """Every sentence in a list batch must score (review regression:
+    only the first was counted)."""
+    m = BLEU(max_n=1)
+    m.update([[1, 2, 3, 4], [5, 6, 7, 8]], [[1, 2, 3, 4], [5, 6, 9, 9]])
+    assert m.num_inst == 2
+    np.testing.assert_allclose(m.get()[1], 6 / 8)
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match="references"):
+        m.update([[1, 2]], [[1, 2], [3, 4]])
+
+
+def test_reset_and_nan_when_empty():
+    m = BLEU()
+    assert math.isnan(m.get()[1])
+    m.update([[1, 2, 3, 4]], [[1, 2, 3, 4]])
+    m.reset()
+    assert math.isnan(m.get()[1])

@@ -113,6 +113,74 @@ def test_ring_rejects_dropout_in_training():
                     impl="ring", dropout_p=0.1)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_single_device(causal):
+    q, k, v = _qkv()
+    mesh = par.make_mesh(sp=4, devices=jax.devices()[:4])
+    with par.mesh_scope(mesh):
+        out = par.ulysses_attention(q, k, v, causal=causal)
+    ref = _ref(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_key_padding_mask_and_grads():
+    q, k, v = _qkv()
+    r = np.random.default_rng(2)
+    mask = jnp.asarray(r.random((2, 32)) > 0.3)
+    mesh = par.make_mesh(sp=4, devices=jax.devices()[:4])
+    with par.mesh_scope(mesh):
+        out = par.ulysses_attention(q, k, v, mask=mask)
+    ref = _ref(q, k, v, mask=mask[:, None, None, :])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    def f_u(q, k, v):
+        with par.mesh_scope(mesh):
+            return par.ulysses_attention(q, k, v, causal=True).sum()
+
+    g1 = jax.grad(f_u, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: _ref(q, k, v, causal=True).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_composes_with_tp_head_sharding():
+    """Under a tp×sp mesh, heads shard over tp and ulysses all-to-alls
+    only the LOCAL heads over sp (review regression: tp was ignored,
+    forcing head replication)."""
+    q, k, v = _qkv(H=4)  # H/tp = 2, divisible by sp = 2
+    mesh = par.make_mesh(tp=2, sp=2, devices=jax.devices()[:4])
+    with par.mesh_scope(mesh):
+        out = par.ulysses_attention(q, k, v, causal=True)
+    ref = _ref(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # H/tp = 2 not divisible by sp = 4 → pointed error
+    mesh2 = par.make_mesh(tp=2, sp=4)
+    with par.mesh_scope(mesh2):
+        with pytest.raises(mx.base.MXNetError, match="per-device heads"):
+            par.ulysses_attention(*_qkv(H=4)[:3])
+
+
+def test_ulysses_via_op_impl_and_validation():
+    q, k, v = _qkv()  # H=4 divisible by sp=4
+    mesh = par.make_mesh(sp=4, devices=jax.devices()[:4])
+    with par.mesh_scope(mesh):
+        out = mx.nd.dot_product_attention(
+            mx.nd.NDArray(q), mx.nd.NDArray(k), mx.nd.NDArray(v),
+            impl="ulysses")
+    np.testing.assert_allclose(out.asnumpy(), np.asarray(_ref(q, k, v)),
+                               rtol=2e-5, atol=2e-5)
+    # H=2 not divisible by sp=4 → pointed error naming the ring
+    q3, k3, v3 = _qkv(H=2)
+    with par.mesh_scope(mesh):
+        with pytest.raises(mx.base.MXNetError, match="ring_attention"):
+            par.ulysses_attention(q3, k3, v3)
+
+
 def test_auto_routes_to_ring_under_sp_mesh():
     """impl='auto' must select the ring path when an sp axis is active —
     SURVEY.md §5.7: sequence parallelism with no model-code changes."""
