@@ -391,6 +391,7 @@ def np_metric(name=None, allow_extra_outputs=False):
     return deco
 
 
+@register("bleu")
 class BLEU(EvalMetric):
     """Corpus BLEU-N with brevity penalty (the NMT-workload metric; the
     reference keeps BLEU in GluonNLP — provided natively here since
@@ -437,7 +438,10 @@ class BLEU(EvalMetric):
             if isinstance(x, (list, tuple)):
                 if x and _np.isscalar(x[0]):
                     return [x]          # one flat sentence
-                return list(x)          # list of sentences
+                out = []                # list of sentences OR of batches
+                for el in x:
+                    out.extend(rows(el))
+                return out
             a = _asnumpy(x)
             return list(a) if a.ndim == 2 else [a]
 

@@ -108,7 +108,6 @@ def ulysses_attention(q, k, v, mask=None, causal=False, scale=None,
     O(T_local)-memory choice for very long T. The per-device head count
     (H, or H/tp under tensor parallelism) must divide by the sp size.
     """
-    import jax.numpy as jnp
     from jax import lax
 
     from ..ops import nn as _opnn

@@ -62,6 +62,17 @@ def test_list_of_sequences_batch():
         m.update([[1, 2]], [[1, 2], [3, 4]])
 
 
+def test_batch_array_in_list_and_registry():
+    """EvalMetric/update_dict convention: a (B, T) array wrapped in a
+    list must score B sentences, not one flattened blob; and BLEU must
+    be constructible from the string registry."""
+    m = mx.metric.create("bleu", max_n=1)
+    batch = np.array([[1, 2, 3, 4], [5, 6, 7, 8]])
+    m.update([batch], [batch])
+    assert m.num_inst == 2
+    np.testing.assert_allclose(m.get()[1], 1.0)
+
+
 def test_reset_and_nan_when_empty():
     m = BLEU()
     assert math.isnan(m.get()[1])

@@ -247,6 +247,39 @@ def test_ssd_forward_and_loss():
     assert det.shape == (2, N, 6)
 
 
+def test_ssd_overfits_single_image():
+    """Convergence smoke: SSD must drive its multibox loss down on one
+    fixed image+boxes (the detection analog of the zoo's convergence
+    test; catches integration bugs unit tests miss — SURVEY.md §4)."""
+    from mxnet_tpu import optimizer as opt, parallel as par
+    from mxnet_tpu.models.vision import ssd_512_resnet50_v1_voc
+    from mxnet_tpu.models.vision.ssd import SSDMultiBoxLoss
+
+    net = ssd_512_resnet50_v1_voc()
+    mx.rng.seed(1)
+    net.initialize(mx.init.Xavier())
+    rng = np.random.default_rng(0)
+    x = mx.nd.array(rng.standard_normal((1, 3, 128, 128)),
+                    dtype="float32")
+    label = np.full((1, 2, 5), -1.0, np.float32)
+    label[0, 0] = [5, 0.2, 0.3, 0.6, 0.8]
+    cls_pred, _, anchors = net(x)
+    bt, bm, ct = mx.nd.multibox_target(
+        anchors, mx.nd.array(label), cls_pred.transpose((0, 2, 1)))
+    # TrainStep calls loss(net_outputs..., labels...): SSD's forward
+    # returns (cls, box, anchors) and the loss takes (cls, box, ct, bt,
+    # bm) — anchors are static, so a small adapter loss drops them
+    class _Adapter(SSDMultiBoxLoss):
+        def forward(self, cls_p, box_p, anc, ctt, btt, bmm):
+            return super().forward(cls_p, box_p, ctt, btt, bmm)
+
+    step = par.TrainStep(net, _Adapter(), opt.SGD(learning_rate=5e-4,
+                                                  momentum=0.9),
+                         mesh=None, n_net_inputs=1)
+    losses = [float(step(x, ct, bt, bm).asscalar()) for _ in range(18)]
+    assert min(losses[-3:]) < 0.7 * losses[0], losses
+
+
 def test_ssd_pretrained_raises():
     from mxnet_tpu.models.vision import ssd_512_resnet50_v1
     with pytest.raises(MXNetError, match="pretrained"):
