@@ -231,7 +231,7 @@ class GPT2ForCausalLM(HybridBlock):
 
     def generate(self, input_ids, max_new_tokens, do_sample=False,
                  temperature=1.0, top_k=None, eos_token_id=None, seed=0,
-                 paged=False, page_size=64):
+                 paged=False, page_size=64, mesh=None):
         """Autoregressive generation: prefill + ONE compiled while_loop
         decode over the static cache (greedy, or top-k/temperature
         sampling). Returns (B, max_new_tokens) int32 NDArray; positions
@@ -239,8 +239,19 @@ class GPT2ForCausalLM(HybridBlock):
 
         This is the SURVEY §3.5 fix: the reference re-concats KV state and
         re-infers shapes per token; here token t+1 costs exactly one
-        cached-program execution."""
+        cached-program execution.
+
+        mesh: pass a device mesh EXPLICITLY for sharded decode —
+        parameters enter with their `param.sharding` specs
+        (apply_sharding_rules / megatron_dense_rules for tensor
+        parallelism) and XLA partitions the whole decode program, cache
+        included, inserting the tp collectives; prompt/outputs stay
+        replicated. An ambient mesh_scope is deliberately NOT picked up
+        (an eval-sample generate inside a training mesh scope should not
+        silently compile a partitioned replica-everything program)."""
         from ..ops.control_flow import while_loop
+        from ..parallel.mesh import PartitionSpec, mesh_scope, \
+            named_sharding
 
         ids = input_ids._data if isinstance(input_ids, NDArray) \
             else jnp.asarray(input_ids)
@@ -319,10 +330,31 @@ class GPT2ForCausalLM(HybridBlock):
 
         key = jax.random.PRNGKey(seed)
         jitted = self.__dict__.setdefault("_generate_cache", {})
+        # Mesh and PartitionSpec hash by value, so equal meshes share the
+        # compiled program, and changing sharding rules between calls
+        # compiles a fresh one instead of reusing stale in_shardings
+        shard_sig = tuple(p.sharding for p in params) \
+            if mesh is not None else None
         sig = (B, T0, max_new_tokens, do_sample, temperature, top_k,
-               eos_token_id, paged, page_size)
+               eos_token_id, paged, page_size, mesh, shard_sig)
         fn = jitted.get(sig)
         if fn is None:
-            fn = jax.jit(run)
+            if mesh is not None:
+                with mesh_scope(mesh):
+                    repl = named_sharding(PartitionSpec())
+                    pshard = tuple(
+                        named_sharding(p.sharding
+                                       if p.sharding is not None
+                                       else PartitionSpec())
+                        for p in params)
+                    fn = jax.jit(run,
+                                 in_shardings=(pshard, repl, repl))
+            else:
+                fn = jax.jit(run)
             jitted[sig] = fn
-        return NDArray(fn(param_datas, ids, key))
+        if mesh is not None:
+            with mesh_scope(mesh):
+                out = fn(param_datas, ids, key)
+        else:
+            out = fn(param_datas, ids, key)
+        return NDArray(out)

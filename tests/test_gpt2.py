@@ -4,6 +4,7 @@ reference's way — full-recompute greedy decode — token for token."""
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 import mxnet_tpu as mx
@@ -138,6 +139,28 @@ def test_paged_decode_write_lands_in_right_page():
     pool = np.asarray(cache.k_pages)[0]
     assert (pool[0, :, 0, 0] == [1, 2, 3, 4]).all()
     assert (pool[1, :2, 0, 0] == [5, 6]).all()
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2,
+                    reason="needs >=2 devices for the tp=2 mesh")
+def test_generate_tensor_parallel_matches_single_device():
+    """Sharded decode: generate() over a tp mesh with megatron-sharded
+    params must emit the same greedy tokens as single-device."""
+    from mxnet_tpu import parallel as par
+
+    net, cfg = _tiny(vocab=96, heads=4, units=32)
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, cfg.vocab_size, (2, 5)).astype(np.int32)
+    want = net.generate(mx.nd.array(prompt, dtype="int32"), 8).asnumpy()
+    par.apply_sharding_rules(net, par.megatron_dense_rules(tp_axis="tp"))
+    mesh = par.make_mesh(tp=2, devices=jax.devices()[:2])
+    got = net.generate(mx.nd.array(prompt, dtype="int32"), 8,
+                       mesh=mesh).asnumpy()
+    np.testing.assert_array_equal(got, want)
+    # paged cache shards too
+    got_p = net.generate(mx.nd.array(prompt, dtype="int32"), 8,
+                         mesh=mesh, paged=True, page_size=8).asnumpy()
+    np.testing.assert_array_equal(got_p, want)
 
 
 def test_gpt2_774m_config_param_count():
