@@ -66,6 +66,7 @@ class TrainCheckpoint:
             key = train_step._base_key
         else:
             key = jnp.zeros_like(jax.random.PRNGKey(0))
+        scale = train_step._scale_state
         return {
             "params": list(train_step._param_arrays),
             "opt_states": [list(s) for s in train_step._opt_states],
@@ -73,6 +74,13 @@ class TrainCheckpoint:
             "base_key": key,
             "has_key": _np.asarray(train_step._base_key is not None),
             "host_t": _np.asarray(train_step._host_t),
+            # dynamic loss-scaler state rides along (placeholder + flag
+            # when unused, so a no-AMP checkpoint can't poison a dynamic
+            # run with scale 0)
+            "scale": (list(scale) if scale is not None
+                      else [jnp.zeros((), jnp.float32),
+                            jnp.zeros((), jnp.int32)]),
+            "has_scale": _np.asarray(scale is not None),
         }
 
     def save(self, step, train_step, data_cursor=None, wait=False):
@@ -115,10 +123,20 @@ class TrainCheckpoint:
         if step is None:
             raise MXNetError(f"no checkpoint found under {self._dir}")
         template = self._state_of(train_step)
-        restored = self._mgr.restore(
-            int(step),
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(template)))
+        try:
+            restored = self._mgr.restore(
+                int(step),
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(template)))
+        except Exception:
+            # checkpoints written before the scale-state fields existed:
+            # retry with the legacy template shape
+            legacy = {k: v for k, v in template.items()
+                      if k not in ("scale", "has_scale")}
+            restored = self._mgr.restore(
+                int(step),
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(legacy)))
         state = restored["state"]
         # rebuild device arrays with the step's shardings
         placed = []
@@ -138,6 +156,12 @@ class TrainCheckpoint:
         if bool(state["has_key"]):
             train_step._base_key = jnp.asarray(state["base_key"],
                                                jnp.uint32)
+        if train_step._scale_state is not None and \
+                bool(state.get("has_scale", False)):
+            sc = state["scale"]
+            train_step._scale_state = (
+                jnp.asarray(sc[0], jnp.float32),
+                jnp.asarray(sc[1], jnp.int32))
         cursor = None
         try:
             cursor = self._mgr.restore(

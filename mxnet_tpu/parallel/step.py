@@ -56,7 +56,15 @@ class TrainStep:
     """
 
     def __init__(self, net, loss_fn, optimizer, mesh=None, batch_specs=None,
-                 donate=True, loss_reduce="mean", n_net_inputs=1):
+                 donate=True, loss_reduce="mean", n_net_inputs=1,
+                 loss_scale=None, scale_window=2000):
+        """loss_scale: None (bf16/f32 path), a float (static scaling), or
+        'dynamic' — fp16-style dynamic loss scaling run ENTIRELY inside
+        the compiled step: the loss is scaled before backward, gradients
+        unscaled before the optimizer, non-finite gradients skip the
+        update via jnp.where, and the scale halves on overflow / doubles
+        after scale_window clean steps — zero host synchronization (the
+        reference's LossScaler pays a device→host check per step)."""
         self.net = net
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -65,6 +73,11 @@ class TrainStep:
         self.donate = donate
         self.loss_reduce = loss_reduce
         self.n_net_inputs = n_net_inputs  # batch[:n] → net, batch[n:] → loss
+        self._dynamic_scale = loss_scale == "dynamic"
+        self._static_scale = (float(loss_scale)
+                              if loss_scale not in (None, "dynamic")
+                              else None)
+        self._scale_window = int(scale_window)
         if not optimizer.fused_supported:
             raise MXNetError(
                 f"{type(optimizer).__name__} has no functional path for the "
@@ -92,6 +105,10 @@ class TrainStep:
             optimizer.init_state_arrays(a) if tr else ()
             for a, tr in zip(self._param_arrays, self._trainable))
         self._t = jnp.zeros((), jnp.int32)
+        # dynamic loss-scaler state lives ON DEVICE in the step carry
+        self._scale_state = (jnp.asarray(2.0 ** 16, jnp.float32),
+                             jnp.zeros((), jnp.int32)) \
+            if self._dynamic_scale else None
         self._host_t = 0
         self._base_key = None
         self._lr_cache = None
@@ -157,24 +174,58 @@ class TrainStep:
             aux = tuple(u for _, u in updates)
             return ldata.astype(jnp.float32), aux
 
-        def step_fn(param_datas, opt_states, t, base_key, lr, wd,
-                    *batch_datas):
+        dynamic = self._dynamic_scale
+        static_scale = self._static_scale
+        scale_window = self._scale_window
+
+        def step_fn(param_datas, opt_states, t, scale_state, base_key,
+                    lr, wd, *batch_datas):
             t = t + 1
             # per-step randomness derived INSIDE the program (no host RNG
             # round-trip per step; the reference's engine-managed Philox
             # streams achieve the same "no host in the loop" property)
             key = jax.random.fold_in(base_key, t)
+            if dynamic:
+                scale, good = scale_state
+            elif static_scale is not None:
+                scale, good = jnp.asarray(static_scale, jnp.float32), None
+            else:
+                scale, good = None, None
 
             def loss_of(trainable_params):
                 full = []
                 it = iter(trainable_params)
                 for base, tr in zip(param_datas, trainable):
                     full.append(next(it) if tr else base)
-                return forward_loss(tuple(full), batch_datas, key)
+                ldata, aux = forward_loss(tuple(full), batch_datas, key)
+                if scale is not None:  # fp16 path: backward on scaled loss
+                    return ldata * scale, (ldata, aux)
+                return ldata, (ldata, aux)
 
             tparams = tuple(d for d, tr in zip(param_datas, trainable) if tr)
-            (loss, aux), grads = jax.value_and_grad(
+            (_, (loss, aux)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(tparams)
+            if scale is not None:
+                inv = 1.0 / scale
+                grads = tuple(
+                    (g.astype(jnp.float32) * inv).astype(g.dtype)
+                    for g in grads)
+            if dynamic:
+                ok = jnp.asarray(True)
+                for g in grads:
+                    ok = ok & jnp.isfinite(
+                        g.astype(jnp.float32)).all()
+                # an overflow step must not poison mutable layer state
+                # either (BN running stats from the same corrupted
+                # forward): keep each stat's incoming value
+                if aux:
+                    olds = []
+                    for sp_param, _ in meta["state_updates"]:
+                        idx = next(i for i, pp in enumerate(params)
+                                   if pp is sp_param)
+                        olds.append(param_datas[idx])
+                    aux = tuple(jnp.where(ok, a, o.astype(a.dtype))
+                                for a, o in zip(aux, olds))
 
             new_params, new_states = [], []
             git = iter(grads)
@@ -189,9 +240,27 @@ class TrainStep:
                 plr = lr * mlr if mlr != 1.0 else lr
                 pwd = wd * mwd if mwd != 1.0 else wd
                 nw, ns = opt.apply_arrays(d, g, st, plr, pwd, t)
+                if dynamic:
+                    # overflow: keep the old weights/states (skip update)
+                    nw = jnp.where(ok, nw, d)
+                    ns = tuple(jnp.where(ok, n, o)
+                               for n, o in zip(ns, st))
                 new_params.append(nw)
                 new_states.append(ns)
-            return tuple(new_params), tuple(new_states), t, loss, aux
+            if dynamic:
+                # in-program dynamic adjustment (reference LossScaler
+                # semantics, zero host syncs)
+                good = jnp.where(ok, good + 1, 0)
+                grow = good >= scale_window
+                scale = jnp.where(
+                    ok, jnp.where(grow, scale * 2.0, scale),
+                    jnp.maximum(scale * 0.5, 1.0))
+                good = jnp.where(grow, 0, good)
+                new_scale_state = (scale, good)
+            else:
+                new_scale_state = scale_state
+            return (tuple(new_params), tuple(new_states), t,
+                    new_scale_state, loss, aux)
 
         donate = (0, 1, 2) if self.donate else ()
         if self.mesh is not None:
@@ -206,10 +275,13 @@ class TrainStep:
                     named_sharding(s) for s in (
                         self.batch_specs or
                         [PartitionSpec("dp")] * n_batch))
+                sscale = jax.tree_util.tree_map(
+                    lambda _: repl, self._scale_state) \
+                    if self._scale_state is not None else ()
                 jitted = jax.jit(
                     step_fn,
-                    in_shardings=(tuple(pspecs), sspecs, repl, repl, repl,
-                                  repl) + bspecs,
+                    in_shardings=(tuple(pspecs), sspecs, repl, sscale,
+                                  repl, repl, repl) + bspecs,
                     donate_argnums=donate)
         else:
             jitted = jax.jit(step_fn, donate_argnums=donate)
@@ -243,23 +315,39 @@ class TrainStep:
                 datas = tuple(
                     jax.device_put(d, named_sharding(s))
                     for d, s in zip(datas, bspecs))
+        scale_state = self._scale_state if self._scale_state is not None \
+            else ()
         if entry["lower_args"] is None:
             # shape structs for AOT lowering (compiled_cost_analysis);
             # can't keep the real arrays — they are donated below
             entry["lower_args"] = jax.tree_util.tree_map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                 (tuple(self._param_arrays), self._opt_states, self._t,
-                 key, lr, wd) + datas)
+                 scale_state, key, lr, wd) + datas)
         with _mesh_ctx(self.mesh):
             out = entry["jitted"](tuple(self._param_arrays),
-                                  self._opt_states, self._t, key, lr, wd,
-                                  *datas)
-        self._param_arrays, self._opt_states, self._t, loss, aux = out
+                                  self._opt_states, self._t, scale_state,
+                                  key, lr, wd, *datas)
+        (new_param_arrays, self._opt_states, self._t, new_scale,
+         loss, aux) = out
+        self._param_arrays = list(new_param_arrays)
+        if self._scale_state is not None:
+            self._scale_state = new_scale
         self._host_t += 1  # mirror of t — no device fetch in the hot loop
         self.optimizer.num_update = self._host_t
-        # mutable layer state (BN stats) written back eagerly
-        for (p, _), new in zip(self._meta.get("state_updates", ()), aux):
-            p._data._rebind(new)
+        # mutable layer state (BN stats) written back into BOTH the
+        # Parameter (eager/eval visibility) AND the step's own param
+        # arrays — the next step's forward reads param_datas, so without
+        # the second write the stats would re-accumulate against their
+        # initial values forever
+        updates = self._meta.get("state_updates", ())
+        if updates:
+            idx_of = {id(p): i for i, p in enumerate(self._params)}
+            for (p, _), new in zip(updates, aux):
+                p._data._rebind(new)
+                i = idx_of.get(id(p))
+                if i is not None:
+                    self._param_arrays[i] = new
         return NDArray(loss)
 
     def sync_params(self):
@@ -271,6 +359,14 @@ class TrainStep:
     @property
     def step_count(self):
         return self._host_t
+
+    @property
+    def loss_scale(self):
+        """Current dynamic loss scale (host fetch), or the static scale,
+        or None on the unscaled path."""
+        if self._scale_state is not None:
+            return float(self._scale_state[0])
+        return self._static_scale
 
     def compiled_cost_analysis(self, sig=None):
         """XLA's cost analysis for a compiled step program (a dict with

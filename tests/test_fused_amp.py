@@ -1,0 +1,125 @@
+"""In-program loss scaling on the fused TrainStep (the AMP story on the
+perf path; reference LossScaler semantics with zero host syncs)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt, parallel as par
+from mxnet_tpu.gluon import loss as gloss, nn
+
+
+def _mk(loss_scale=None, scale_window=2000):
+    net = nn.Dense(3, in_units=4)
+    mx.rng.seed(0)
+    net.initialize(mx.init.Normal(0.1))
+    step = par.TrainStep(net, gloss.L2Loss(), opt.SGD(learning_rate=0.05),
+                         mesh=None, loss_scale=loss_scale,
+                         scale_window=scale_window)
+    return net, step
+
+
+def _batch(scale=1.0, seed=0):
+    r = np.random.default_rng(seed)
+    x = mx.nd.array(r.standard_normal((8, 4)) * scale, dtype="float32")
+    y = mx.nd.array(r.standard_normal((8, 3)), dtype="float32")
+    return x, y
+
+
+def test_static_scale_matches_unscaled():
+    """In f32, scaling the loss up and the grads back down is a no-op."""
+    x, y = _batch()
+    _, plain = _mk()
+    ref = [float(plain(x, y).asscalar()) for _ in range(5)]
+    _, scaled = _mk(loss_scale=1024.0)
+    got = [float(scaled(x, y).asscalar()) for _ in range(5)]
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    assert scaled.loss_scale == 1024.0
+
+
+def test_dynamic_scale_trains_and_reports():
+    x, y = _batch()
+    _, step = _mk(loss_scale="dynamic")
+    losses = [float(step(x, y).asscalar()) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    assert step.loss_scale == 2.0 ** 16  # no overflow → unchanged
+
+
+def test_dynamic_scale_skips_overflow_and_halves():
+    x, y = _batch()
+    _, step = _mk(loss_scale="dynamic")
+    step(x, y)
+    before = np.asarray(step._param_arrays[0]).copy()
+    bad_x = mx.nd.array(np.full((8, 4), np.inf, np.float32))
+    loss = step(bad_x, y)  # overflow step
+    assert step.loss_scale == 2.0 ** 15  # halved
+    np.testing.assert_array_equal(np.asarray(step._param_arrays[0]),
+                                  before)  # update skipped
+    # training continues cleanly afterwards
+    l2 = float(step(x, y).asscalar())
+    assert np.isfinite(l2)
+
+
+def test_dynamic_scale_grows_after_window():
+    x, y = _batch()
+    _, step = _mk(loss_scale="dynamic", scale_window=3)
+    for _ in range(3):
+        step(x, y)
+    assert step.loss_scale == 2.0 ** 17  # doubled after 3 clean steps
+
+
+def test_overflow_does_not_poison_bn_stats():
+    """Skipped updates must also skip mutable-state writes (review
+    regression: BN running stats absorbed inf from the overflow
+    forward)."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=4))
+    net.add(nn.BatchNorm(in_channels=4))
+    net.add(nn.Dense(3, in_units=4))
+    mx.rng.seed(0)
+    net.initialize(mx.init.Normal(0.1))
+    step = par.TrainStep(net, gloss.L2Loss(), opt.SGD(learning_rate=0.01),
+                         mesh=None, loss_scale="dynamic")
+    x, y = _batch()
+    step(x, y)
+    params = net.collect_params()
+    mean_p = [p for k, p in params.items() if "running_mean" in k
+              or "moving_mean" in k][0]
+    before = mean_p.data().asnumpy().copy()
+    step(mx.nd.array(np.full((8, 4), np.inf, np.float32)), y)
+    np.testing.assert_array_equal(mean_p.data().asnumpy(), before)
+    # clean step resumes stat updates
+    step(x, y)
+    assert np.isfinite(mean_p.data().asnumpy()).all()
+    assert not np.array_equal(mean_p.data().asnumpy(), before)
+
+
+def test_no_amp_checkpoint_into_dynamic_step(tmp_path):
+    """Restoring a no-AMP checkpoint must keep the dynamic step's 2^16
+    init scale, not the 0.0 placeholder (review regression)."""
+    from mxnet_tpu.checkpoint import TrainCheckpoint
+    x, y = _batch()
+    _, plain = _mk()
+    plain(x, y)
+    ck = TrainCheckpoint(str(tmp_path))
+    ck.save(1, plain, wait=True)
+    _, dyn = _mk(loss_scale="dynamic")
+    ck.restore(dyn)
+    assert dyn.loss_scale == 2.0 ** 16
+    loss = float(dyn(x, y).asscalar())
+    assert np.isfinite(loss)
+    ck.close()
+
+
+def test_dynamic_scale_checkpoint_roundtrip(tmp_path):
+    from mxnet_tpu.checkpoint import TrainCheckpoint
+    x, y = _batch()
+    _, step = _mk(loss_scale="dynamic")
+    step(x, y)
+    step(mx.nd.array(np.full((8, 4), np.inf, np.float32)), y)
+    assert step.loss_scale == 2.0 ** 15
+    ck = TrainCheckpoint(str(tmp_path))
+    ck.save(2, step, wait=True)
+    step(x, y)
+    ck.restore(step)
+    assert step.loss_scale == 2.0 ** 15  # scaler state resumed exactly
+    ck.close()
