@@ -128,15 +128,20 @@ class TrainCheckpoint:
                 int(step),
                 args=ocp.args.Composite(
                     state=ocp.args.StandardRestore(template)))
-        except Exception:
+        except Exception as first_err:
             # checkpoints written before the scale-state fields existed:
-            # retry with the legacy template shape
+            # retry with the legacy template shape — but surface the
+            # ORIGINAL error if that is not the problem (a genuine
+            # mismatch/corruption must not hide behind the retry)
             legacy = {k: v for k, v in template.items()
                       if k not in ("scale", "has_scale")}
-            restored = self._mgr.restore(
-                int(step),
-                args=ocp.args.Composite(
-                    state=ocp.args.StandardRestore(legacy)))
+            try:
+                restored = self._mgr.restore(
+                    int(step),
+                    args=ocp.args.Composite(
+                        state=ocp.args.StandardRestore(legacy)))
+            except Exception:
+                raise first_err
         state = restored["state"]
         # rebuild device arrays with the step's shardings
         placed = []

@@ -48,12 +48,17 @@ fully_connected = FullyConnected
 # convolution
 # ---------------------------------------------------------------------------
 
-def _tup(v, n):
+def _tup(v, n, fill=0):
+    """Normalize an int/tuple/None window param to an n-tuple. The
+    reference treats an absent or all-zero stride/dilate tuple as "use
+    the default" (dmlc::Parameter empty-tuple convention), so when
+    `fill` is nonzero an all-zero value also resolves to the fill."""
     if v is None:
-        return (0,) * n if n else v
-    if isinstance(v, int):
-        return (v,) * n
-    return tuple(int(i) for i in v)
+        return (fill,) * n
+    v = (v,) * n if isinstance(v, int) else tuple(int(i) for i in v)
+    if fill and v and builtins.all(i == 0 for i in v):
+        return (fill,) * n
+    return v
 
 
 @op("Convolution")
@@ -64,11 +69,9 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     emits an MXU conv. Supports 1D/2D/3D by kernel rank, grouped conv via
     feature_group_count."""
     nd = weight.ndim - 2
-    stride = _tup(stride, nd) or (1,) * nd
-    dilate = _tup(dilate, nd) or (1,) * nd
+    stride = _tup(stride, nd, fill=1)
+    dilate = _tup(dilate, nd, fill=1)
     pad = _tup(pad, nd)
-    if builtins.all(s == 0 for s in stride):
-        stride = (1,) * nd
     spatial = "DHW"[-nd:] if nd <= 3 else None
     if spatial is None:
         raise MXNetError(f"unsupported conv rank {nd}")
@@ -104,10 +107,10 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None,
     """Parity: src/operator/nn/deconvolution.cc — gradient of conv w.r.t.
     input, i.e. transposed convolution."""
     nd = weight.ndim - 2
-    stride = _tup(stride, nd) or (1,) * nd
-    dilate = _tup(dilate, nd) or (1,) * nd
+    stride = _tup(stride, nd, fill=1)
+    dilate = _tup(dilate, nd, fill=1)
     pad = _tup(pad, nd)
-    adj = _tup(adj, nd) or (0,) * nd
+    adj = _tup(adj, nd)
     if num_group != 1:
         xs = jnp.split(data, num_group, axis=1)
         ws = jnp.split(weight, num_group, axis=0)
@@ -435,8 +438,8 @@ def Pooling(data, kernel=None, pool_type="max", global_pool=False,
             raise MXNetError(f"unknown pool_type {pool_type}")
         return out
     kernel = _tup(kernel, nd)
-    stride = _tup(stride, nd) or (1,) * nd
-    pad = _tup(pad, nd) or (0,) * nd
+    stride = _tup(stride, nd, fill=1)
+    pad = _tup(pad, nd)
     window = (1, 1) + kernel
     strides = (1, 1) + stride
     padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)

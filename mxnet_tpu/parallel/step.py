@@ -252,8 +252,12 @@ class TrainStep:
                 # semantics, zero host syncs)
                 good = jnp.where(ok, good + 1, 0)
                 grow = good >= scale_window
+                # growth capped at 2^24 so a perpetually-clean run can
+                # never double the scale into f32 inf (which would wedge
+                # training with every update skipped)
                 scale = jnp.where(
-                    ok, jnp.where(grow, scale * 2.0, scale),
+                    ok, jnp.where(grow, jnp.minimum(scale * 2.0, 2.0 ** 24),
+                                  scale),
                     jnp.maximum(scale * 0.5, 1.0))
                 good = jnp.where(grow, 0, good)
                 new_scale_state = (scale, good)
@@ -344,10 +348,15 @@ class TrainStep:
         if updates:
             idx_of = {id(p): i for i, p in enumerate(self._params)}
             for (p, _), new in zip(updates, aux):
-                p._data._rebind(new)
                 i = idx_of.get(id(p))
                 if i is not None:
                     self._param_arrays[i] = new
+                # the array placed in param_arrays gets DONATED next
+                # step; the Parameter must hold its own buffer or eager
+                # reads would hit a deleted array on real hardware
+                p._data._rebind(jnp.copy(new) if (self.donate
+                                                  and i is not None)
+                                else new)
         return NDArray(loss)
 
     def sync_params(self):
