@@ -99,6 +99,43 @@ def test_attention_matches_torch_sdpa():
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
 
 
+def test_pooling_matches_torch():
+    x = _rand(2, 3, 9, 9)
+    got = mx.nd.Pooling(mx.nd.array(x), kernel=(3, 3), stride=(2, 2),
+                        pool_type="max").asnumpy()
+    want = torch.nn.functional.max_pool2d(torch.tensor(x), 3, 2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # avg with padding counts pad cells like the reference default
+    got = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                        pad=(1, 1), pool_type="avg").asnumpy()
+    want = torch.nn.functional.avg_pool2d(
+        torch.tensor(x), 2, 2, padding=1,
+        count_include_pad=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # no stride given → default 1 (the _tup fill path)
+    got = mx.nd.Pooling(mx.nd.array(x), kernel=(3, 3),
+                        pool_type="max").asnumpy()
+    want = torch.nn.functional.max_pool2d(torch.tensor(x), 3, 1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # ceil-mode ('full' convention)
+    got = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                        pool_type="max",
+                        pooling_convention="full").asnumpy()
+    want = torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2,
+                                          ceil_mode=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_embedding_matches_torch():
+    w = _rand(11, 5)
+    idx = np.array([[0, 3, 10], [7, 7, 1]], np.int64)
+    got = mx.nd.take(mx.nd.array(w), mx.nd.array(idx, dtype="int32"),
+                     axis=0).asnumpy()
+    want = torch.nn.functional.embedding(
+        torch.tensor(idx), torch.tensor(w)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
 def test_lstm_matches_torch():
     T, B, I, H = 5, 3, 4, 6
     x = _rand(T, B, I)
