@@ -230,8 +230,9 @@ class GPT2ForCausalLM(HybridBlock):
                           dtype=dtype or jnp.dtype(c.dtype), **kw)
 
     def generate(self, input_ids, max_new_tokens, do_sample=False,
-                 temperature=1.0, top_k=None, eos_token_id=None, seed=0,
-                 paged=False, page_size=64, mesh=None):
+                 temperature=1.0, top_k=None, top_p=None,
+                 eos_token_id=None, seed=0, paged=False, page_size=64,
+                 mesh=None):
         """Autoregressive generation: prefill + ONE compiled while_loop
         decode over the static cache (greedy, or top-k/temperature
         sampling). Returns (B, max_new_tokens) int32 NDArray; positions
@@ -275,9 +276,27 @@ class GPT2ForCausalLM(HybridBlock):
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
             if temperature != 1.0:
                 logits = logits / temperature
-            if top_k is not None:
-                kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            if top_k is not None or top_p is not None:
+                # ONE descending sort serves both filters (per decode
+                # step in the compiled loop — don't sort twice)
+                sort_idx = jnp.argsort(-logits, axis=-1)
+                sorted_logits = jnp.take_along_axis(logits, sort_idx,
+                                                    axis=-1)
+                cut_sorted = jnp.zeros(logits.shape, bool)
+                if top_k is not None:
+                    cut_sorted |= jnp.arange(
+                        logits.shape[-1])[None, :] >= top_k
+                if top_p is not None:
+                    # nucleus: cut token i only if the mass STRICTLY
+                    # before it already exceeds top_p — the top-1 token
+                    # always survives (even top_p=0)
+                    probs = jax.nn.softmax(sorted_logits, axis=-1)
+                    cum = jnp.cumsum(probs, axis=-1)
+                    cut_sorted |= (cum - probs) > top_p
+                cut = jnp.zeros_like(cut_sorted).at[
+                    jnp.arange(logits.shape[0])[:, None], sort_idx].set(
+                    cut_sorted)
+                logits = jnp.where(cut, -jnp.inf, logits)
             k = jax.random.fold_in(key, step)
             return jax.random.categorical(k, logits, axis=-1).astype(
                 jnp.int32)
@@ -336,7 +355,7 @@ class GPT2ForCausalLM(HybridBlock):
         shard_sig = tuple(p.sharding for p in params) \
             if mesh is not None else None
         sig = (B, T0, max_new_tokens, do_sample, temperature, top_k,
-               eos_token_id, paged, page_size, mesh, shard_sig)
+               top_p, eos_token_id, paged, page_size, mesh, shard_sig)
         fn = jitted.get(sig)
         if fn is None:
             if mesh is not None:

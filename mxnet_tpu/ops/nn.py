@@ -662,28 +662,35 @@ def _target_platform(x):
             pass
     return jax.default_backend()
 
-def _ring_auto_ok(q, k, mask, train_drop):
-    """True when impl='auto' should route to ring attention: an active
-    mesh with a real sp axis (SURVEY.md §5.7: 'selected by mesh axis
-    mapping — no model-code changes'), self-attention shapes divisible by
-    the mesh axes, no attention-prob dropout, and a key-padding-style
-    mask (the only kind the ring rotates)."""
+def _sp_auto_impl(q, k, mask, train_drop):
+    """The sequence-parallel route impl='auto' should take, or None.
+
+    Selected by mesh axis mapping — no model-code changes (SURVEY.md
+    §5.7): requires an active mesh with a real sp axis, self-attention
+    shapes divisible by the mesh axes, no attention-prob dropout, and a
+    key-padding-style mask. Between the two SP kernels: 'ulysses' (head
+    all-to-all, 2 collectives, full-T scores) when the per-device head
+    count divides by sp and T is moderate; 'ring' (ppermute KV rotation,
+    O(T_local) memory) otherwise."""
     from ..parallel.mesh import AXIS_SP, current_mesh
     from ..parallel.sp import sp_enabled
     mesh = current_mesh()
     if train_drop or not sp_enabled(mesh):
-        return False
+        return None
     n_sp = mesh.shape[AXIS_SP]
     B, H, Tq, _ = q.shape
     Tk = k.shape[-2]
     if Tq != Tk or Tq % n_sp:
-        return False
+        return None
     if mask is not None and (mask.shape[1] != 1 or mask.shape[-2] != 1):
-        return False  # per-query masks don't rotate; key padding only
+        return None  # per-query masks don't shard; key padding only
     for ax, dim in (("dp", B), ("tp", H)):
         if ax in mesh.axis_names and dim % mesh.shape[ax]:
-            return False
-    return True
+            return None
+    n_tp = mesh.shape["tp"] if "tp" in mesh.axis_names else 1
+    if (H // n_tp) % n_sp == 0 and Tq <= 4096:
+        return "ulysses"
+    return "ring"
 
 
 @op("dot_product_attention")
@@ -707,8 +714,10 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
         # (B, Tk) key-padding → canonical (B, 1, 1, Tk) for every path
         mask = mask[:, None, None, :]
     train_drop = dropout_p > 0 and is_training()
-    if impl == "auto" and _ring_auto_ok(q, k, mask, train_drop):
-        impl = "ring"
+    if impl == "auto":
+        sp_impl = _sp_auto_impl(q, k, mask, train_drop)
+        if sp_impl is not None:
+            impl = sp_impl
     if impl in ("ring", "ulysses"):
         # sequence-parallel paths: T sharded over the mesh's "sp" axis —
         # ring rotates KV via ppermute (O(T_local) memory); ulysses

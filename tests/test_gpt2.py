@@ -92,6 +92,29 @@ def test_sampled_decode_reproducible_and_valid():
     assert ((a >= 0) & (a < cfg.vocab_size)).all()
 
 
+def test_top_p_sampling():
+    net, cfg = _tiny()
+    prompt = np.zeros((2, 3), np.int32)
+    # top_p=0 keeps ONLY the top token → exactly greedy
+    tp = net.generate(mx.nd.array(prompt, dtype="int32"), 6,
+                      do_sample=True, top_p=0.0, seed=1).asnumpy()
+    greedy = net.generate(mx.nd.array(prompt, dtype="int32"), 6).asnumpy()
+    np.testing.assert_array_equal(tp, greedy)
+    # p=1 keeps the whole distribution == plain sampling, same seed
+    full = net.generate(mx.nd.array(prompt, dtype="int32"), 6,
+                        do_sample=True, top_p=1.0, seed=4).asnumpy()
+    plain = net.generate(mx.nd.array(prompt, dtype="int32"), 6,
+                         do_sample=True, seed=4).asnumpy()
+    np.testing.assert_array_equal(full, plain)
+    # truncating nucleus is reproducible and in-vocab; combines w/ top_k
+    a = net.generate(mx.nd.array(prompt, dtype="int32"), 6,
+                     do_sample=True, top_p=0.9, top_k=20, seed=4).asnumpy()
+    b = net.generate(mx.nd.array(prompt, dtype="int32"), 6,
+                     do_sample=True, top_p=0.9, top_k=20, seed=4).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    assert ((a >= 0) & (a < cfg.vocab_size)).all()
+
+
 def test_kv_cache_contiguous_roundtrip():
     cache = KVCache.create(num_layers=2, batch=2, num_heads=3, max_length=8,
                            head_dim=4)

@@ -181,39 +181,51 @@ def test_ulysses_via_op_impl_and_validation():
             par.ulysses_attention(q3, k3, v3)
 
 
-def test_auto_routes_to_ring_under_sp_mesh():
-    """impl='auto' must select the ring path when an sp axis is active —
-    SURVEY.md §5.7: sequence parallelism with no model-code changes."""
-    from mxnet_tpu.ops.nn import _ring_auto_ok
-    q, k, v = _qkv()
+def test_auto_routes_to_sp_under_sp_mesh():
+    """impl='auto' must select a sequence-parallel path when an sp axis
+    is active — SURVEY.md §5.7: SP with no model-code changes. Ulysses
+    when per-device heads divide by sp, ring otherwise."""
+    from mxnet_tpu.ops.nn import _sp_auto_impl
+    q, k, v = _qkv()  # H=4
     mesh = par.make_mesh(sp=4, devices=jax.devices()[:4])
     with par.mesh_scope(mesh):
-        assert _ring_auto_ok(q, k, None, train_drop=False)
-        assert not _ring_auto_ok(q, k, None, train_drop=True)
+        assert _sp_auto_impl(q, k, None, train_drop=False) == "ulysses"
+        assert _sp_auto_impl(q, k, None, train_drop=True) is None
         out = dpa.raw_fn(q, k, v, impl="auto")
     ref = _ref(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+    # heads not divisible by sp → the ring path
+    q2, k2, v2 = _qkv(H=2)
+    with par.mesh_scope(mesh):
+        assert _sp_auto_impl(q2, k2, None, train_drop=False) == "ring"
+        out = dpa.raw_fn(q2, k2, v2, impl="auto")
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_ref(q2, k2, v2)),
+                               rtol=2e-5, atol=2e-5)
     # T=30 not divisible by sp=4 → falls back, still correct
     qo, ko, vo = (a[:, :, :30] for a in (q, k, v))
     with par.mesh_scope(mesh):
-        assert not _ring_auto_ok(qo, ko, None, train_drop=False)
+        assert _sp_auto_impl(qo, ko, None, train_drop=False) is None
         out = dpa.raw_fn(qo, ko, vo, impl="auto")
     np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(qo, ko, vo)),
                                rtol=2e-5, atol=2e-5)
-    # no mesh → no ring
-    assert not _ring_auto_ok(q, k, None, train_drop=False)
+    # no mesh → no sp route
+    assert _sp_auto_impl(q, k, None, train_drop=False) is None
 
 
 def test_trainstep_sp_end_to_end():
-    """BERT TrainStep over a dp×sp mesh: impl='auto' puts the ppermute ring
-    in the compiled step, and the loss trajectory matches single-device."""
+    """BERT TrainStep over a dp×sp mesh: impl='auto' puts a sequence-
+    parallel collective (ulysses all-to-all here: heads divide by sp) in
+    the compiled step, and the loss trajectory matches single-device."""
     mesh = par.make_mesh(dp=2, sp=2, devices=jax.devices()[:4])
     losses_sp, step = _train_bert_steps(
         mesh, rules=None, seq_specs=True, return_step=True)
     txt = step._lowered().as_text()
-    assert "collective_permute" in txt or "collective-permute" in txt, \
-        "sp mesh active but no ppermute ring in the compiled train step"
+    assert any(t in txt for t in ("all_to_all", "all-to-all",
+                                  "collective_permute",
+                                  "collective-permute")), \
+        "sp mesh active but no SP collective in the compiled train step"
     losses_single, _ = _train_bert_steps(None, rules=None, return_step=True)
     np.testing.assert_allclose(losses_sp, losses_single, rtol=2e-4,
                                atol=1e-5)
