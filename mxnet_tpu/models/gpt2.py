@@ -254,6 +254,9 @@ class GPT2ForCausalLM(HybridBlock):
         from ..parallel.mesh import PartitionSpec, mesh_scope, \
             named_sharding
 
+        if top_p is not None and top_p >= 1.0:
+            top_p = None  # the full distribution — a true no-op (f32
+            # cumsum rounding above 1.0 would otherwise cut tail tokens)
         ids = input_ids._data if isinstance(input_ids, NDArray) \
             else jnp.asarray(input_ids)
         ids = ids.astype(jnp.int32)

@@ -705,11 +705,13 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
     shapes), with or without dropout. 'flash' is the blockwise O(T)
     kernel in ops/attention.py for long sequences; 'ring' and 'ulysses'
     the sequence-parallel paths (ppermute KV rotation vs head
-    all-to-all; parallel/sp.py). 'auto' picks ring whenever the active mesh
-    has a real sp axis and shapes/dropout allow (so sequence parallelism
-    needs no model-code changes), else fused on TPU when shapes allow,
-    flash for long no-dropout sequences, else one XLA softmax-attention.
-    Fully-masked rows yield zeros on every path."""
+    all-to-all; parallel/sp.py). 'auto' picks a sequence-parallel path
+    whenever the active mesh has a real sp axis and shapes/dropout allow
+    (ulysses when per-device heads divide by sp and T is moderate, ring
+    otherwise — so sequence parallelism needs no model-code changes),
+    else fused on TPU when shapes allow, flash for long no-dropout
+    sequences, else one XLA softmax-attention. Fully-masked rows yield
+    zeros on every path."""
     if mask is not None and mask.ndim == 2:
         # (B, Tk) key-padding → canonical (B, 1, 1, Tk) for every path
         mask = mask[:, None, None, :]

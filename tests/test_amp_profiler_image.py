@@ -134,6 +134,22 @@ def test_profiler_pause_resume():
     assert json.loads(paused_stats) == {}
 
 
+def test_profiler_jax_device_trace(tmp_path):
+    """trace_dir engages the jax/XLA device trace (TensorBoard xplane
+    output) alongside the aggregate table."""
+    tb = tmp_path / "tb"
+    mx.profiler.set_config(trace_dir=str(tb))
+    mx.profiler.set_state("run")
+    try:
+        with mx.profiler.scope("traced_region"):
+            mx.nd.array([1.0, 2.0]).sum().asscalar()
+    finally:
+        mx.profiler.set_state("stop")
+        mx.profiler.set_config(trace_dir=None)
+    written = list(tb.rglob("*"))
+    assert any(p.is_file() for p in written), written
+
+
 def test_profiler_rejects_bad_config():
     with pytest.raises(MXNetError):
         mx.profiler.set_config(bogus_key=1)
