@@ -2,7 +2,7 @@
 """SSD detection end to end: anchors → targets → multibox loss → fused
 train step → decode+NMS → VOC mAP, on a synthetic two-box dataset.
 
-Usage: JAX_PLATFORMS=cpu python examples/train_ssd.py --steps 20"""
+Usage: JAX_PLATFORMS=cpu python examples/train_ssd.py"""
 import argparse
 import os
 import sys
@@ -15,7 +15,7 @@ import numpy as np
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--steps", type=int, default=60)
     p.add_argument("--size", type=int, default=128)
     args = p.parse_args()
 

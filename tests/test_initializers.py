@@ -1,0 +1,95 @@
+"""Initializer zoo property tests (parity: python/mxnet/initializer.py —
+each initializer checked against its defining mathematical property)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+
+
+def _materialize(init, shape, name="weight"):
+    from mxnet_tpu.gluon.parameter import Parameter
+
+    p = Parameter(name, shape=shape, init=init)
+    p.initialize()
+    return p.data().asnumpy()
+
+
+def test_orthogonal_rows_are_orthonormal():
+    w = _materialize(mx.init.Orthogonal(scale=1.0), (6, 12))
+    gram = w @ w.T
+    np.testing.assert_allclose(gram, np.eye(6), atol=1e-5)
+    # scale multiplies the orthonormal basis
+    w2 = _materialize(mx.init.Orthogonal(scale=2.0), (6, 12))
+    np.testing.assert_allclose(w2 @ w2.T, 4 * np.eye(6), atol=1e-4)
+
+
+def test_identity_and_validation():
+    w = _materialize(mx.init.Identity(), (4, 4))
+    np.testing.assert_array_equal(w, np.eye(4))
+    w = _materialize(mx.init.Identity(init_value=3), (3, 5))
+    np.testing.assert_array_equal(w, 3 * np.eye(3, 5))
+    with pytest.raises(MXNetError, match="2D"):
+        _materialize(mx.init.Identity(), (2, 3, 4))
+
+
+def test_bilinear_kernel_upsamples_constants_exactly():
+    """The defining property: a deconv with bilinear weights and
+    stride 2 upsamples a constant field to a constant field."""
+    w = _materialize(mx.init.Bilinear(), (1, 1, 4, 4))
+    x = mx.nd.array(np.ones((1, 1, 5, 5)), dtype="float32")
+    y = mx.nd.Deconvolution(x, mx.nd.array(w), None, kernel=(4, 4),
+                            stride=(2, 2), pad=(1, 1), num_filter=1,
+                            no_bias=True).asnumpy()
+    interior = y[0, 0, 2:-2, 2:-2]
+    np.testing.assert_allclose(interior, 1.0, rtol=1e-5)
+
+
+def test_lstmbias_sets_forget_gate_only():
+    b = _materialize(mx.init.LSTMBias(forget_bias=2.5), (16,), name="bias")
+    n = 4
+    np.testing.assert_array_equal(b[:n], 0)
+    np.testing.assert_array_equal(b[n:2 * n], 2.5)
+    np.testing.assert_array_equal(b[2 * n:], 0)
+
+
+def test_xavier_variance():
+    w = _materialize(mx.init.Xavier(factor_type="avg", magnitude=3),
+                     (256, 256))
+    # uniform over ±sqrt(3*2/(in+out)) → std = bound/sqrt(3)
+    bound = np.sqrt(3 * 2.0 / 512)
+    assert np.abs(w).max() <= bound + 1e-6
+    np.testing.assert_allclose(w.std(), bound / np.sqrt(3), rtol=0.1)
+
+
+def test_msraprelu_gaussian_variance():
+    w = _materialize(mx.init.MSRAPrelu(slope=0.0), (512, 128))
+    # He init: std = sqrt(2/fan_avg) for factor_type=avg
+    np.testing.assert_allclose(w.std(), np.sqrt(2.0 / 320), rtol=0.15)
+
+
+def test_constant_zero_one():
+    np.testing.assert_array_equal(
+        _materialize(mx.init.Zero(), (3, 3)), 0)
+    np.testing.assert_array_equal(
+        _materialize(mx.init.One(), (3, 3)), 1)
+    np.testing.assert_array_equal(
+        _materialize(mx.init.Constant(0.25), (2, 2)), 0.25)
+
+
+def test_mixed_pattern_dispatch():
+    init = mx.init.Mixed([".*bias", ".*"],
+                         [mx.init.Zero(), mx.init.One()])
+    net = nn.Dense(3, in_units=2)
+    net.initialize(init)
+    np.testing.assert_array_equal(net.bias.data().asnumpy(), 0)
+    np.testing.assert_array_equal(net.weight.data().asnumpy(), 1)
+
+
+def test_string_aliases_resolve():
+    for alias in ("zeros", "ones", "uniform", "normal", "xavier",
+                  "orthogonal", "msraprelu"):
+        net = nn.Dense(2, in_units=2, weight_initializer=alias)
+        net.initialize()
+        assert net.weight.data().shape == (2, 2)
