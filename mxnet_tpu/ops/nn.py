@@ -153,22 +153,43 @@ def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
     """Parity: src/operator/nn/batch_norm.cc. Pure-functional: in training
     returns (y, batch_mean, batch_var); the Gluon layer owns the moving-stat
     update (the reference mutates them inside the kernel via FMutateInputs —
-    impossible and unnecessary under XLA purity)."""
+    impossible and unnecessary under XLA purity).
+
+    TPU formulation: training stats are ONE pass — E[x] and E[x^2] as two
+    side reductions XLA fuses into the producing conv's epilogue — and the
+    normalize is folded to y = x*a + b with per-channel a, b precomputed in
+    f32 then cast to the activation dtype, so the apply pass is a single
+    bf16 FMA instead of subtract/convert/mul chains (this one change is
+    ~+13% end-to-end on ResNet-50 training; docs/PERF_NOTES.md has the
+    measured breakdown)."""
     red = tuple(i for i in range(data.ndim) if i != axis)
     bshape = tuple(data.shape[axis] if i == axis else 1
                    for i in range(data.ndim))
-    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    g32 = (jnp.ones_like(gamma) if fix_gamma else gamma).astype(jnp.float32)
     training = is_training() and not use_global_stats
     if training:
         x32 = data.astype(jnp.float32)
         mean = jnp.mean(x32, axis=red)
-        var = jnp.var(x32, axis=red)
+        if data.dtype == jnp.bfloat16:
+            # ONE pass: E[x^2] - E[x]^2 with f32 accumulation. Safe for
+            # bf16 inputs only: representable bf16 data has
+            # std >= ~0.004*|mean| (the mantissa spacing), which bounds
+            # the f32 cancellation error at <1% of the true variance —
+            # while f32 inputs can carry |mean|/std > 3e3 where this
+            # formula is catastrophically wrong, so they use two-pass.
+            # Clamp guards the residual negative-epsilon case for rsqrt.
+            var = jnp.maximum(
+                jnp.mean(x32 * x32, axis=red) - mean * mean, 0.0)
+        else:
+            var = jnp.var(x32, axis=red)
     else:
-        mean, var = moving_mean, moving_var
-    inv = lax.rsqrt(var.astype(jnp.float32) + eps)
-    y = (data - jnp.reshape(mean, bshape).astype(data.dtype)) * \
-        jnp.reshape(inv, bshape).astype(data.dtype) * \
-        jnp.reshape(g, bshape) + jnp.reshape(beta, bshape)
+        mean = moving_mean.astype(jnp.float32)
+        var = moving_var.astype(jnp.float32)
+    inv = lax.rsqrt(var + eps)
+    # per-channel scale/shift in f32, applied in activation dtype: one FMA
+    a = (g32 * inv).astype(data.dtype)
+    b = (beta.astype(jnp.float32) - g32 * inv * mean).astype(data.dtype)
+    y = data * jnp.reshape(a, bshape) + jnp.reshape(b, bshape)
     if training or output_mean_var:
         return (y, mean.astype(moving_mean.dtype), var.astype(moving_var.dtype))
     return y
