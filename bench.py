@@ -73,7 +73,7 @@ def bench_bert(large=False):
     on_tpu = dev.platform != "cpu"
     seq_len = int(os.environ.get("BENCH_SEQ_LEN", 512))
     n_masked = int(os.environ.get("BENCH_MASKED", 76))
-    steps = int(os.environ.get("BENCH_STEPS", 10))
+    steps = int(os.environ.get("BENCH_STEPS", 30))
     mk_cfg = bert_large_config if large else bert_base_config
     cfg = mk_cfg(dtype="bfloat16" if on_tpu else "float32",
                  dropout=0.1, max_length=seq_len)
@@ -115,14 +115,16 @@ def bench_bert(large=False):
 
             # warmup (compile); NOTE: scalar fetch, not block_until_ready —
             # the remote-TPU platform's block_until_ready does not actually
-            # block, only a data fetch synchronizes. The final loss depends
-            # on the whole donated param chain, so one fetch times all steps.
-            float(step(ids, tt, vl, pos, labels).asscalar())
-            float(step(ids, tt, vl, pos, labels).asscalar())
+            # block, only a data fetch synchronizes. Timed section runs the
+            # K steps device-chained (TrainStep.run_steps — the engine-bulk
+            # analog): one dispatch, K optimizer steps, one fetch, so the
+            # per-step figure is the device's sustained training rate.
+            batch_args = (ids, tt, vl, pos, labels)
+            float(step.run_steps(*batch_args, steps=steps)
+                  .asnumpy()[-1])
             t0 = time.perf_counter()
-            for _ in range(steps):
-                loss = step(ids, tt, vl, pos, labels)
-            float(loss.asscalar())
+            losses = step.run_steps(*batch_args, steps=steps)
+            float(losses.asnumpy()[-1])
             dt = (time.perf_counter() - t0) / steps
             break
         except Exception as e:  # OOM etc. → try smaller batch
@@ -168,7 +170,7 @@ def bench_resnet50():
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
-    steps = int(os.environ.get("BENCH_STEPS", 10))
+    steps = int(os.environ.get("BENCH_STEPS", 30))
     image_size = int(os.environ.get("BENCH_IMAGE_SIZE", 224))
     classes = 1000
     candidates = [int(b) for b in (os.environ.get("BENCH_BATCH")
@@ -193,12 +195,14 @@ def bench_resnet50():
             net(x[:1])  # finish deferred shape inference before TrainStep
             o = opt.SGD(learning_rate=0.1, momentum=0.9, wd=1e-4)
             step = par.TrainStep(net, lfn, o, mesh=None, n_net_inputs=1)
+            # timed section device-chains the K steps (engine-bulk
+            # analog); the single-step call also compiles the per-call
+            # program whose XLA cost analysis provides the MFU flop count
             float(step(x, y).asscalar())
-            float(step(x, y).asscalar())
+            float(step.run_steps(x, y, steps=steps).asnumpy()[-1])
             t0 = time.perf_counter()
-            for _ in range(steps):
-                loss = step(x, y)
-            float(loss.asscalar())
+            losses = step.run_steps(x, y, steps=steps)
+            float(losses.asnumpy()[-1])
             dt = (time.perf_counter() - t0) / steps
             break
         except Exception as e:
@@ -215,7 +219,11 @@ def bench_resnet50():
     # (He et al. 2015, table 1) when cost analysis is unavailable.
     step_flops, flops_source = None, "analytic"
     try:
-        cost = step.compiled_cost_analysis()
+        # cost of the SINGLE-step program (the last-called program is the
+        # K-chained one, whose flop count is K x one step)
+        single_sig = tuple((tuple(d.shape), str(d.dtype))
+                           for d in (x._data, y._data))
+        cost = step.compiled_cost_analysis(sig=single_sig)
         if cost and cost.get("flops"):
             step_flops = float(cost["flops"])
             flops_source = "xla_cost_analysis"

@@ -49,24 +49,31 @@ class MultiHeadAttention(HybridBlock):
         self.proj = Dense(units, flatten=False, use_bias=use_bias,
                           in_units=units)
 
-    def _split(self, x):
+    def _split(self, x, bthd=False):
+        """Head split. Default returns canonical (B,H,T,D) — external
+        cache-decode paths (models/nmt.py) index it that way. bthd=True
+        skips the transpose: the attention op takes (B,T,H,D) natively
+        (packed Pallas kernel slices heads; XLA einsum contracts any
+        layout), so the minor-dim reshape is free and no relayout copy
+        ever hits HBM."""
         b, t, _ = x.shape
         h, d = self._num_heads, self._units // self._num_heads
-        return x.reshape((b, t, h, d)).transpose((0, 2, 1, 3))
+        x = x.reshape((b, t, h, d))
+        return x if bthd else x.transpose((0, 2, 1, 3))
 
     def forward(self, x, mask=None, kv=None):
         kv = x if kv is None else kv
-        q = self._split(self.query(x))
-        k = self._split(self.key(kv))
-        v = self._split(self.value(kv))
+        q = self._split(self.query(x), bthd=True)
+        k = self._split(self.key(kv), bthd=True)
+        v = self._split(self.value(kv), bthd=True)
         if mask is not None and mask.ndim == 2:
             # (B, Tk) valid mask → (B, 1, 1, Tk) broadcast over heads/query
             mask = mask.reshape((mask.shape[0], 1, 1, mask.shape[1]))
         out = _opnn.dot_product_attention(
             q, k, v, mask, causal=self._causal, dropout_p=self._dropout,
-            impl=self._impl)
-        b, h, t, d = out.shape
-        out = out.transpose((0, 2, 1, 3)).reshape((b, t, h * d))
+            impl=self._impl, layout="BTHD")
+        b, t, h, d = out.shape
+        out = out.reshape((b, t, h * d))
         return self.proj(out)
 
 

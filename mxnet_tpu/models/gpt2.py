@@ -101,40 +101,49 @@ class GPT2Attention(HybridBlock):
         self.value = Dense(units, flatten=False, in_units=units)
         self.proj = Dense(units, flatten=False, in_units=units)
 
-    def _split(self, x):
+    def _split(self, x, bthd=False):
         b, t, _ = x.shape
         h, d = self._num_heads, self._units // self._num_heads
-        return x.reshape((b, t, h, d)).transpose((0, 2, 1, 3))
+        x = x.reshape((b, t, h, d))
+        return x if bthd else x.transpose((0, 2, 1, 3))
 
     def forward(self, x, cache=None, layer_idx=None):
+        if cache is None:
+            # training path: head split stays in BTHD — the attention op
+            # consumes it natively (packed Pallas kernel), so no
+            # (B,T,H,D)->(B,H,T,D) relayout copies hit HBM
+            q = self._split(self.query(x), bthd=True)
+            k = self._split(self.key(x), bthd=True)
+            v = self._split(self.value(x), bthd=True)
+            out = _opnn.dot_product_attention(
+                q, k, v, causal=True, dropout_p=self._dropout,
+                impl=self._impl, layout="BTHD")
+            b, t, h, d = out.shape
+            out = out.reshape((b, t, h * d))
+            return self.proj(out), cache
+        # static-cache path (inference): write this chunk at position
+        # cache.length, attend over the full buffer under a validity ×
+        # causal mask. The chunk is either the whole prompt (prefill)
+        # or one token (decode). Cache blocks are laid out BHTD.
         q = self._split(self.query(x))
         k = self._split(self.key(x))
         v = self._split(self.value(x))
-        if cache is None:
-            out = _opnn.dot_product_attention(
-                q, k, v, causal=True, dropout_p=self._dropout,
-                impl=self._impl)
+        t = q.shape[2]
+        if t > 1:
+            k_all, v_all, cache = cache.write_prompt(
+                layer_idx, k._data, v._data)
         else:
-            # static-cache path (inference): write this chunk at position
-            # cache.length, attend over the full buffer under a validity ×
-            # causal mask. The chunk is either the whole prompt (prefill)
-            # or one token (decode).
-            t = q.shape[2]
-            if t > 1:
-                k_all, v_all, cache = cache.write_prompt(
-                    layer_idx, k._data, v._data)
-            else:
-                k_all, v_all, cache = cache.write(
-                    layer_idx, k._data, v._data)
-            valid = cache.key_mask(extra=t)           # (T_max,)
-            q_pos = cache.length + jnp.arange(t)      # global positions
-            k_pos = jnp.arange(k_all.shape[2])
-            causal = k_pos[None, :] <= q_pos[:, None]  # (t, T_max)
-            mask = (valid[None, :] & causal)[None, None]  # (1,1,t,T_max)
-            out = _opnn.dot_product_attention(
-                q, NDArray(k_all.astype(q._data.dtype)),
-                NDArray(v_all.astype(q._data.dtype)), NDArray(mask),
-                impl="xla" if self._impl == "ring" else self._impl)
+            k_all, v_all, cache = cache.write(
+                layer_idx, k._data, v._data)
+        valid = cache.key_mask(extra=t)           # (T_max,)
+        q_pos = cache.length + jnp.arange(t)      # global positions
+        k_pos = jnp.arange(k_all.shape[2])
+        causal = k_pos[None, :] <= q_pos[:, None]  # (t, T_max)
+        mask = (valid[None, :] & causal)[None, None]  # (1,1,t,T_max)
+        out = _opnn.dot_product_attention(
+            q, NDArray(k_all.astype(q._data.dtype)),
+            NDArray(v_all.astype(q._data.dtype)), NDArray(mask),
+            impl="xla" if self._impl == "ring" else self._impl)
         b, h, t, d = out.shape
         out = out.transpose((0, 2, 1, 3)).reshape((b, t, h * d))
         return self.proj(out), cache

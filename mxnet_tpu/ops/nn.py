@@ -716,8 +716,12 @@ def _sp_auto_impl(q, k, mask, train_drop):
 
 @op("dot_product_attention")
 def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
-                          dropout_p=0.0, impl="auto"):
-    """q,k,v: (B, H, T, D). impl:
+                          dropout_p=0.0, impl="auto", layout="BHTD"):
+    """q,k,v: (B, H, T, D) — or (B, T, H, D) with layout="BTHD", the
+    shape a head-split reshape produces directly; the fused Pallas
+    kernel and the XLA einsum path consume BTHD natively (no physical
+    relayout copies — measured ~6.6 ms/step on BERT-base), other impls
+    transpose internally. impl:
     'auto'|'xla'|'fused'|'flash'|'ring'|'ulysses'.
 
     'fused' is the Pallas TPU kernel (ops/pallas_attention.py): whole-row
@@ -737,6 +741,49 @@ def dot_product_attention(q, k, v, mask=None, scale=None, causal=False,
         # (B, Tk) key-padding → canonical (B, 1, 1, Tk) for every path
         mask = mask[:, None, None, :]
     train_drop = dropout_p > 0 and is_training()
+    if layout == "BTHD":
+        # native-BTHD routes first (fused kernel / XLA einsum); anything
+        # else transposes to canonical BHTD and re-enters
+        bhtd = lambda x: jnp.swapaxes(x, 1, 2)
+        if impl in ("auto", "fused"):
+            from . import pallas_attention as _pa
+            if (_target_platform(q) == "tpu"
+                    and _pa.supported(q, k, mask, layout="BTHD")
+                    and (impl == "fused" or _sp_auto_impl(
+                        bhtd(q), bhtd(k), mask, train_drop) is None)):
+                key = _rng.next_key() if train_drop else None
+                return _pa.fused_attention(
+                    q, k, v, mask=mask, scale=scale, causal=causal,
+                    dropout_p=dropout_p if train_drop else 0.0, key=key,
+                    layout="BTHD")
+        if impl == "xla":
+            d = q.shape[-1]
+            s = scale if scale is not None else 1.0 / _pymath.sqrt(d)
+            logits = (jnp.einsum("bqhd,bkhd->bhqk", q, k) * s).astype(
+                jnp.float32)
+            if causal:
+                Tq, Tk = logits.shape[-2], logits.shape[-1]
+                cm = jnp.tril(jnp.ones((Tq, Tk), bool), Tk - Tq)
+                logits = jnp.where(cm, logits, -jnp.inf)
+            if mask is not None:
+                logits = jnp.where(mask, logits, -jnp.inf)
+            w = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+            if causal or mask is not None:
+                any_valid = jnp.isfinite(logits).any(axis=-1,
+                                                     keepdims=True)
+                w = jnp.where(any_valid, w, jnp.zeros((), w.dtype))
+            if train_drop:
+                kk = _rng.next_key()
+                keep = jax.random.bernoulli(kk, 1.0 - dropout_p, w.shape)
+                w = jnp.where(keep, w / (1.0 - dropout_p),
+                              jnp.zeros((), w.dtype))
+            return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+        # raw_fn: the plain jax-array function (we are already inside
+        # the op funnel; re-entering the NDArray wrapper would nest tapes)
+        out = dot_product_attention.raw_fn(
+            bhtd(q), bhtd(k), bhtd(v), mask=mask, scale=scale,
+            causal=causal, dropout_p=dropout_p, impl=impl)
+        return jnp.swapaxes(out, 1, 2)
     if impl == "auto":
         sp_impl = _sp_auto_impl(q, k, mask, train_drop)
         if sp_impl is not None:

@@ -118,6 +118,7 @@ class TrainStep:
         # bucket size gets its own compiled program, parameters shared
         self._programs = {}
         self._last_sig = None
+        self._last_single_sig = None
         self._meta = {}
         if self.mesh is not None:
             self._place_sharded()
@@ -140,7 +141,11 @@ class TrainStep:
         return [_spec_or_replicated(p.sharding) for p in self._params]
 
     # -- build -------------------------------------------------------------
-    def _build(self, n_batch):
+    def _make_core(self):
+        """The one-training-step function shared by the per-call program
+        and the device-chained multi-step program:
+        core(tr, opt, t, scale_state, nt, key, lr, wd, batch) ->
+        (new_tr, new_opt, t, new_scale, loss, aux)."""
         net, loss_fn, opt = self.net, self.loss_fn, self.optimizer
         params = self._params
         trainable = self._trainable
@@ -195,8 +200,10 @@ class TrainStep:
         tr_lr_mults = [m for m, tr in zip(self._lr_mults, trainable) if tr]
         tr_wd_mults = [m for m, tr in zip(self._wd_mults, trainable) if tr]
 
-        def step_fn(tr_datas, opt_states, t, scale_state, nt_datas,
-                    base_key, lr, wd, *batch_datas):
+        self._nt_pos, self._tr_pos = nt_pos, tr_pos
+
+        def core(tr_datas, opt_states, t, scale_state, nt_datas,
+                 base_key, lr, wd, batch_datas):
             t = t + 1
             # per-step randomness derived INSIDE the program (no host RNG
             # round-trip per step; the reference's engine-managed Philox
@@ -286,35 +293,122 @@ class TrainStep:
             return (tuple(new_params), tuple(new_states), t,
                     new_scale_state, loss, aux)
 
+        return core
+
+    def _jit_shardings(self, n_batch, stacked=False):
+        """(in_shardings tuple, or None when no mesh) for the step args
+        (tr, opt_states, t, scale_state, nt, key, lr, wd, *batch).
+        stacked=True prepends an unsharded leading steps axis to each
+        batch spec (the run_steps layout)."""
+        if self.mesh is None:
+            return None
+        trainable = self._trainable
+        with mesh_scope(self.mesh):
+            pspecs = [named_sharding(s)
+                      for s in self.param_sharding_specs()]
+            tr_pspecs = tuple(s for s, tr in zip(pspecs, trainable) if tr)
+            nt_pspecs = tuple(s for s, tr in zip(pspecs, trainable)
+                              if not tr)
+            sspecs = tuple(
+                tuple(pspecs[i] for _ in st)
+                for i, st in enumerate(self._opt_states)
+                if trainable[i])
+            repl = named_sharding(PartitionSpec())
+            raw_bspecs = (self.batch_specs or
+                          [PartitionSpec("dp")] * n_batch)
+            if stacked:
+                raw_bspecs = [PartitionSpec(None, *tuple(s))
+                              for s in raw_bspecs]
+            bspecs = tuple(named_sharding(s) for s in raw_bspecs)
+            sscale = jax.tree_util.tree_map(
+                lambda _: repl, self._scale_state) \
+                if self._scale_state is not None else ()
+            return (tr_pspecs, sspecs, repl, sscale,
+                    nt_pspecs, repl, repl, repl) + bspecs
+
+    def _build(self, n_batch):
+        core = self._make_core()
+
+        def step_fn(tr_datas, opt_states, t, scale_state, nt_datas,
+                    base_key, lr, wd, *batch_datas):
+            return core(tr_datas, opt_states, t, scale_state, nt_datas,
+                        base_key, lr, wd, batch_datas)
+
         donate = (0, 1, 2) if self.donate else ()
-        if self.mesh is not None:
+        shardings = self._jit_shardings(n_batch)
+        if shardings is not None:
             with mesh_scope(self.mesh):
-                pspecs = [named_sharding(s)
-                          for s in self.param_sharding_specs()]
-                tr_pspecs = tuple(s for s, tr in zip(pspecs, trainable)
-                                  if tr)
-                nt_pspecs = tuple(s for s, tr in zip(pspecs, trainable)
-                                  if not tr)
-                sspecs = tuple(
-                    tuple(pspecs[i] for _ in st)
-                    for i, st in enumerate(self._opt_states)
-                    if trainable[i])
-                repl = named_sharding(PartitionSpec())
-                bspecs = tuple(
-                    named_sharding(s) for s in (
-                        self.batch_specs or
-                        [PartitionSpec("dp")] * n_batch))
-                sscale = jax.tree_util.tree_map(
-                    lambda _: repl, self._scale_state) \
-                    if self._scale_state is not None else ()
-                jitted = jax.jit(
-                    step_fn,
-                    in_shardings=(tr_pspecs, sspecs, repl, sscale,
-                                  nt_pspecs, repl, repl, repl) + bspecs,
-                    donate_argnums=donate)
+                jitted = jax.jit(step_fn, in_shardings=shardings,
+                                 donate_argnums=donate)
         else:
             jitted = jax.jit(step_fn, donate_argnums=donate)
         return jitted
+
+    def _build_multi(self, n_batch, repeat_steps=None):
+        """Device-chained multi-step program: lax.scan over K stacked
+        batches (or the SAME batch repeat_steps times when repeat_steps
+        is set), ONE dispatch for K optimizer steps. The TPU-native
+        analog of the reference's engine bulk mode (MXNET_ENGINE_BULK /
+        engine.bulk batching many engine ops per scheduling round,
+        SURVEY.md §2.1): host dispatch cost is paid once per K steps
+        instead of per step, which matters when the host link has
+        latency (remote TPU) or the per-step pytree is large.
+
+        Mutable layer state (BN stats) is threaded through the scan
+        carry, so K chained steps accumulate stats exactly like K
+        single-step calls. lr/wd are captured once per dispatch —
+        host-side schedulers take effect between run_steps() calls."""
+        core = self._make_core()
+        trainable = self._trainable
+        params = self._params
+        meta = self._meta
+        nt_pos, tr_pos = self._nt_pos, self._tr_pos
+        n_rep = repeat_steps
+
+        def multi_fn(tr_datas, opt_states, t, scale_state, nt_datas,
+                     base_key, lr, wd, *stacked):
+            def body(carry, xs):
+                tr_c, opt_c, t_c, scale_c, nt_c = carry
+                (tr_n, opt_n, t_n, scale_n, loss, aux) = core(
+                    tr_c, opt_c, t_c, scale_c, nt_c, base_key, lr, wd,
+                    stacked if n_rep else xs)
+                if aux:
+                    # thread state updates (BN stats) into the carry the
+                    # same way __call__ threads them into _param_arrays:
+                    # the update wins over the optimizer write
+                    nt_n = list(nt_c)
+                    tr_n = list(tr_n)
+                    for (p, _), new in zip(meta["state_updates"], aux):
+                        idx = next(i for i, pp in enumerate(params)
+                                   if pp is p)
+                        if idx in nt_pos:
+                            nt_n[nt_pos[idx]] = new.astype(
+                                nt_c[nt_pos[idx]].dtype)
+                        else:
+                            tr_n[tr_pos[idx]] = new.astype(
+                                tr_c[tr_pos[idx]].dtype)
+                    nt_n, tr_n = tuple(nt_n), tuple(tr_n)
+                else:
+                    nt_n = nt_c
+                return (tr_n, opt_n, t_n, scale_n, nt_n), loss
+
+            init = (tr_datas, opt_states, t, scale_state, nt_datas)
+            (tr_f, opt_f, t_f, scale_f, nt_f), losses = jax.lax.scan(
+                body, init, None if n_rep else stacked,
+                length=n_rep if n_rep else None)
+            return tr_f, opt_f, t_f, scale_f, nt_f, losses
+
+        # nt is NOT donated even here: its input buffers may be the very
+        # arrays the Parameters hold (after a prior stat write-back), and
+        # they are tiny
+        donate = (0, 1, 2) if self.donate else ()
+        shardings = self._jit_shardings(n_batch,
+                                        stacked=repeat_steps is None)
+        if shardings is not None:
+            with mesh_scope(self.mesh):
+                return jax.jit(multi_fn, in_shardings=shardings,
+                               donate_argnums=donate)
+        return jax.jit(multi_fn, donate_argnums=donate)
 
     # -- run ---------------------------------------------------------------
     def __call__(self, *batch):
@@ -326,17 +420,7 @@ class TrainStep:
             entry = {"jitted": self._build(len(datas)), "lower_args": None}
             self._programs[sig] = entry
         self._last_sig = sig
-        if self._base_key is None:
-            self._base_key = _rng.next_key()
-        # cache device scalars for lr/wd — refresh only when the host value
-        # changes (schedulers); avoids 2 H2D transfers per step
-        lr_v = float(self.optimizer.learning_rate)
-        wd_v = float(self.optimizer.wd)
-        if self._lr_cache is None or self._lr_cache[0] != lr_v:
-            self._lr_cache = (lr_v, jnp.asarray(lr_v, jnp.float32))
-        if self._wd_cache is None or self._wd_cache[0] != wd_v:
-            self._wd_cache = (wd_v, jnp.asarray(wd_v, jnp.float32))
-        key, lr, wd = self._base_key, self._lr_cache[1], self._wd_cache[1]
+        self._last_single_sig = sig
         if self.mesh is not None:
             with mesh_scope(self.mesh):
                 bspecs = (self.batch_specs or
@@ -344,33 +428,14 @@ class TrainStep:
                 datas = tuple(
                     jax.device_put(d, named_sharding(s))
                     for d, s in zip(datas, bspecs))
-        scale_state = self._scale_state if self._scale_state is not None \
-            else ()
-        tr_arrays = tuple(a for a, tr in zip(self._param_arrays,
-                                             self._trainable) if tr)
-        nt_arrays = tuple(a for a, tr in zip(self._param_arrays,
-                                             self._trainable) if not tr)
-        tr_states = tuple(s for s, tr in zip(self._opt_states,
-                                             self._trainable) if tr)
-        if entry["lower_args"] is None:
-            # shape structs for AOT lowering (compiled_cost_analysis);
-            # can't keep the real arrays — they are donated below
-            entry["lower_args"] = jax.tree_util.tree_map(
-                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-                (tr_arrays, tr_states, self._t, scale_state, nt_arrays,
-                 key, lr, wd) + datas)
+        (tr_arrays, tr_states, scale_state, nt_arrays, key, lr,
+         wd) = self._prepare_dispatch(entry, datas)
         with _mesh_ctx(self.mesh):
             out = entry["jitted"](tr_arrays, tr_states, self._t,
                                   scale_state, nt_arrays, key, lr, wd,
                                   *datas)
         (new_tr_arrays, new_tr_states, self._t, new_scale, loss, aux) = out
-        it_p, it_s = iter(new_tr_arrays), iter(new_tr_states)
-        for i, tr in enumerate(self._trainable):
-            if tr:
-                self._param_arrays[i] = next(it_p)
-        self._opt_states = tuple(
-            next(it_s) if tr else st
-            for st, tr in zip(self._opt_states, self._trainable))
+        self._write_back(new_tr_arrays, new_tr_states)
         if self._scale_state is not None:
             self._scale_state = new_scale
         self._host_t += 1  # mirror of t — no device fetch in the hot loop
@@ -397,6 +462,127 @@ class TrainStep:
                                     and self._trainable[i]) else new)
         return NDArray(loss)
 
+    def _prepare_dispatch(self, entry, datas):
+        """Common per-dispatch state: (tr_arrays, tr_states, scale_state,
+        nt_arrays, key, lr, wd). Also fills entry["lower_args"] on first
+        use (shape structs for AOT lowering — the real arrays may be
+        donated by the call)."""
+        if self._base_key is None:
+            self._base_key = _rng.next_key()
+        # cache device scalars for lr/wd — refresh only when the host
+        # value changes (schedulers); avoids 2 H2D transfers per step
+        lr_v = float(self.optimizer.learning_rate)
+        wd_v = float(self.optimizer.wd)
+        if self._lr_cache is None or self._lr_cache[0] != lr_v:
+            self._lr_cache = (lr_v, jnp.asarray(lr_v, jnp.float32))
+        if self._wd_cache is None or self._wd_cache[0] != wd_v:
+            self._wd_cache = (wd_v, jnp.asarray(wd_v, jnp.float32))
+        key, lr, wd = self._base_key, self._lr_cache[1], self._wd_cache[1]
+        scale_state = self._scale_state if self._scale_state is not None \
+            else ()
+        tr_arrays = tuple(a for a, tr in zip(self._param_arrays,
+                                             self._trainable) if tr)
+        nt_arrays = tuple(a for a, tr in zip(self._param_arrays,
+                                             self._trainable) if not tr)
+        tr_states = tuple(st for st, tr in zip(self._opt_states,
+                                               self._trainable) if tr)
+        if entry["lower_args"] is None:
+            entry["lower_args"] = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                (tr_arrays, tr_states, self._t, scale_state, nt_arrays,
+                 key, lr, wd) + datas)
+        return tr_arrays, tr_states, scale_state, nt_arrays, key, lr, wd
+
+    def _write_back(self, new_tr, new_states):
+        """Fold trainable step outputs into _param_arrays/_opt_states."""
+        it_p, it_s = iter(new_tr), iter(new_states)
+        for i, tr in enumerate(self._trainable):
+            if tr:
+                self._param_arrays[i] = next(it_p)
+        self._opt_states = tuple(
+            next(it_s) if tr else st
+            for st, tr in zip(self._opt_states, self._trainable))
+
+    def run_steps(self, *stacked_batch, steps=None):
+        """Run K chained optimizer steps in ONE device dispatch.
+
+        Default: each argument is the per-call batch with an extra
+        leading steps axis — shapes [K, ...] where a plain __call__
+        takes [...]. With steps=K given, the arguments are ordinary
+        single-step batches and the SAME batch is reused K times
+        (steady-state benchmarking / overfit smokes — no stacked upload).
+        Returns the per-step losses as an NDArray of shape (K,).
+        Equivalent to K sequential __call__s (BN stats and the RNG
+        stream thread through identically), except lr/wd are sampled
+        once per dispatch — host-side LR schedulers take effect between
+        run_steps calls.
+
+        TPU-native analog of the reference's engine bulk execution
+        (MXNET_ENGINE_BULK, SURVEY.md §2.1): amortizes host dispatch over
+        K steps, which dominates wall time on high-latency device links."""
+        datas = tuple(b._data if isinstance(b, NDArray) else jnp.asarray(b)
+                      for b in stacked_batch)
+        if steps is None:
+            if not datas or any(d.ndim < 1 for d in datas):
+                raise MXNetError("run_steps needs batches with a leading "
+                                 "steps axis (or pass steps=K)")
+            k = datas[0].shape[0]
+            for d in datas:
+                if d.shape[0] != k:
+                    raise MXNetError(
+                        f"run_steps: inconsistent steps axis "
+                        f"{d.shape[0]} vs {k}")
+        else:
+            k = int(steps)
+            if k <= 0:
+                raise MXNetError("run_steps: steps must be positive")
+        sig = ("multi", steps is None, k if steps is not None else None) \
+            + tuple((tuple(d.shape), str(d.dtype)) for d in datas)
+        entry = self._programs.get(sig)
+        if entry is None:
+            entry = {"jitted": self._build_multi(
+                len(datas), repeat_steps=None if steps is None else k),
+                "lower_args": None}
+            self._programs[sig] = entry
+        self._last_sig = sig
+        if self.mesh is not None:
+            with mesh_scope(self.mesh):
+                raw = (self.batch_specs or
+                       [PartitionSpec("dp")] * len(datas))
+                if steps is None:  # stacked layout: leading K unsharded
+                    raw = [PartitionSpec(None, *tuple(s)) for s in raw]
+                datas = tuple(
+                    jax.device_put(d, named_sharding(s))
+                    for d, s in zip(datas, raw))
+        (tr_arrays, tr_states, scale_state, nt_arrays, key, lr,
+         wd) = self._prepare_dispatch(entry, datas)
+        with _mesh_ctx(self.mesh):
+            out = entry["jitted"](tr_arrays, tr_states, self._t,
+                                  scale_state, nt_arrays, key, lr, wd,
+                                  *datas)
+        (new_tr, new_states, self._t, new_scale, new_nt, losses) = out
+        self._write_back(new_tr, new_states)
+        it_n = iter(new_nt)
+        for i, tr in enumerate(self._trainable):
+            if not tr:
+                self._param_arrays[i] = next(it_n)
+        if self._scale_state is not None:
+            self._scale_state = new_scale
+        self._host_t += k
+        self.optimizer.num_update = self._host_t
+        # stat write-back: the final nt values are fresh (non-donated-
+        # input) output buffers — Parameters can own them directly
+        updates = self._meta.get("state_updates", ())
+        if updates:
+            idx_of = {id(p): i for i, p in enumerate(self._params)}
+            for p, _ in updates:
+                i = idx_of.get(id(p))
+                if i is not None:
+                    p._data._rebind(jnp.copy(self._param_arrays[i])
+                                    if (self.donate and self._trainable[i])
+                                    else self._param_arrays[i])
+        return NDArray(losses)
+
     def sync_params(self):
         """Write the step's device arrays back into the Block's Parameters
         (so save_parameters / eager eval see current weights)."""
@@ -418,14 +604,25 @@ class TrainStep:
     def compiled_cost_analysis(self, sig=None):
         """XLA's cost analysis for a compiled step program (a dict with
         'flops' etc.), or None before the first call / when the backend
-        does not report costs. This is the authoritative per-step flop
+        does not report costs. This is the authoritative PER-STEP flop
         count for MFU math — no hand-derived estimates. sig selects a
-        program from the bucket cache; default = the last one called."""
+        program from the bucket cache; default = the last SINGLE-step
+        program called (a K-chained run_steps program reports K steps of
+        flops, so its counts are divided by K before returning)."""
+        if sig is None and self._last_single_sig is not None:
+            sig = self._last_single_sig
+        if sig is None:
+            sig = self._last_sig
         try:
             compiled = self._lowered(sig).compile()
             ca = compiled.cost_analysis()
             if isinstance(ca, (list, tuple)):
                 ca = ca[0] if ca else None
+            if ca and isinstance(sig, tuple) and len(sig) > 2 \
+                    and sig and sig[0] == "multi":
+                k = sig[2] if sig[2] is not None else sig[3][0][0]
+                ca = {key: (v / k if isinstance(v, (int, float)) else v)
+                      for key, v in dict(ca).items()}
             return ca
         except Exception:
             return None

@@ -1,0 +1,89 @@
+"""Device-chained multi-step execution (TrainStep.run_steps): K steps in
+one dispatch must be bit-equivalent to K single-step calls — params,
+optimizer states, BN running stats, RNG stream, and per-step losses.
+Reference analog: engine bulk execution (MXNET_ENGINE_BULK, SURVEY.md
+§2.1)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt, parallel as par
+from mxnet_tpu.gluon import loss as gloss, nn
+
+
+def _mk_convbn():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1), nn.BatchNorm(),
+            nn.Activation("relu"), nn.GlobalAvgPool2D(), nn.Dense(4))
+    mx.rng.seed(7)
+    net.initialize(mx.init.Xavier())
+    x1 = mx.nd.array(np.zeros((4, 3, 8, 8)), dtype="float32")
+    net(x1)
+    step = par.TrainStep(net, gloss.SoftmaxCrossEntropyLoss(),
+                         opt.SGD(learning_rate=0.1, momentum=0.9),
+                         mesh=None)
+    return net, step
+
+
+def _batches(k=4, seed=3):
+    r = np.random.default_rng(seed)
+    xs = r.standard_normal((k, 16, 3, 8, 8)).astype(np.float32)
+    ys = r.integers(0, 4, (k, 16)).astype(np.int32)
+    return xs, ys
+
+
+def test_run_steps_matches_single_calls():
+    xs, ys = _batches()
+    net_a, step_a = _mk_convbn()
+    mx.rng.seed(123)  # base_key draw must match across paths
+    ref_losses = [float(step_a(mx.nd.array(x), mx.nd.array(y)).asscalar())
+                  for x, y in zip(xs, ys)]
+
+    net_b, step_b = _mk_convbn()
+    mx.rng.seed(123)
+    losses = step_b.run_steps(mx.nd.array(xs), mx.nd.array(ys)).asnumpy()
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-6, atol=1e-7)
+    for a, b in zip(step_a._param_arrays, step_b._param_arrays):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    # BN running stats visible on the Parameters after the chained call
+    rm_a = net_a[1].running_mean.data().asnumpy()
+    rm_b = net_b[1].running_mean.data().asnumpy()
+    np.testing.assert_allclose(rm_b, rm_a, rtol=1e-6, atol=1e-7)
+    assert abs(rm_b).max() > 0
+    assert step_b.step_count == step_a.step_count == len(xs)
+
+
+def test_run_steps_then_single_step_interleave():
+    """Chained and per-call programs share one state; interleaving works."""
+    xs, ys = _batches(k=2)
+    net, step = _mk_convbn()
+    step.run_steps(mx.nd.array(xs), mx.nd.array(ys))
+    l1 = float(step(mx.nd.array(xs[0]), mx.nd.array(ys[0])).asscalar())
+    losses = step.run_steps(mx.nd.array(xs), mx.nd.array(ys)).asnumpy()
+    assert np.isfinite(losses).all() and np.isfinite(l1)
+    assert step.step_count == 5
+
+
+def test_run_steps_dynamic_scale():
+    """Dynamic loss scaling threads through the scan carry."""
+    net = nn.Dense(3, in_units=4)
+    mx.rng.seed(0)
+    net.initialize(mx.init.Normal(0.1))
+    step = par.TrainStep(net, gloss.L2Loss(), opt.SGD(learning_rate=0.05),
+                         mesh=None, loss_scale="dynamic", scale_window=2)
+    r = np.random.default_rng(0)
+    xs = r.standard_normal((6, 8, 4)).astype(np.float32)
+    ys = r.standard_normal((6, 8, 3)).astype(np.float32)
+    losses = step.run_steps(mx.nd.array(xs), mx.nd.array(ys)).asnumpy()
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    assert step.loss_scale >= 2.0 ** 16  # grew after clean windows
+
+
+def test_run_steps_shape_validation():
+    net, step = _mk_convbn()
+    xs, ys = _batches(k=3)
+    with pytest.raises(mx.MXNetError):
+        step.run_steps(mx.nd.array(xs), mx.nd.array(ys[:2]))
