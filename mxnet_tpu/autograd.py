@@ -98,11 +98,20 @@ class Node:
       ('node', Node, out_idx)  — produced by another taped op
       ('leaf', NDArray)        — a grad-attached variable
       None                     — constant (no gradient flows)
+
+    primal_fn/inputs keep the op re-executable: backward(create_graph=
+    True) re-derives the VJP THROUGH the op funnel as taped ops, so the
+    produced gradients are themselves differentiable (the reference's
+    higher-order grad; its FGradient entries are symbolic for the same
+    reason). func_info carries the same capability for user Function
+    nodes (their backward() is NDArray code that tapes when recorded).
     """
 
-    __slots__ = ("name", "vjp_fn", "parents", "out_avals", "saved", "multi")
+    __slots__ = ("name", "vjp_fn", "parents", "out_avals", "saved",
+                 "multi", "primal_fn", "inputs", "func_info")
 
-    def __init__(self, name, vjp_fn, parents, out_avals, multi=None):
+    def __init__(self, name, vjp_fn, parents, out_avals, multi=None,
+                 primal_fn=None, inputs=None, func_info=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.parents = parents
@@ -111,10 +120,16 @@ class Node:
         # whether the primal returned a tuple (vjp cotangent structure
         # must match exactly, even for 1-element tuples)
         self.multi = len(out_avals) > 1 if multi is None else multi
+        self.primal_fn = primal_fn
+        self.inputs = inputs
+        self.func_info = func_info
 
     def release(self):
         self.vjp_fn = None
         self.saved = None
+        self.primal_fn = None
+        self.inputs = None
+        self.func_info = None
 
 
 def tape_entry(arr):
@@ -131,10 +146,15 @@ def is_tracked(arr) -> bool:
     return arr._node is not None or arr._grad_req != "null"
 
 
-def record_node(name, vjp_fn, input_arrays, output_arrays, multi=None):
+def record_node(name, vjp_fn, input_arrays, output_arrays, multi=None,
+                primal_fn=None, func_info=None):
     parents = tuple(tape_entry(a) for a in input_arrays)
     out_avals = tuple((o.shape, o.dtype) for o in output_arrays)
-    node = Node(name, vjp_fn, parents, out_avals, multi=multi)
+    node = Node(name, vjp_fn, parents, out_avals, multi=multi,
+                primal_fn=primal_fn,
+                inputs=tuple(input_arrays) if primal_fn is not None
+                else None,
+                func_info=func_info)
     for i, o in enumerate(output_arrays):
         o._node = ("node", node, i)
     return node
@@ -162,13 +182,23 @@ def _toposort(head_nodes):
     return order  # parents before children
 
 
-def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
+             create_graph=False):
     """Run backward from `heads` (parity: mx.autograd.backward).
 
     head_grads: matching list of NDArray/None; None means ones_like (the
     reference uses ones for scalar-loss convenience).
+
+    create_graph=True runs the whole backward THROUGH the op funnel so
+    the written .grad buffers are themselves on the tape — a further
+    backward/grad over them yields higher-order derivatives (parity:
+    autograd.grad(create_graph=True) + test_higher_order_grad.py).
+    Implies retain_graph.
     """
     from .ndarray.ndarray import NDArray  # cycle-free at call time
+
+    if create_graph:
+        return _backward_taped(heads, head_grads, train_mode)
 
     if isinstance(heads, NDArray):
         heads = [heads]
@@ -257,18 +287,15 @@ def _accum(store, key, arr, ct):
 
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
-    """Parity: mx.autograd.grad — return grads w.r.t. `variables` instead of
-    writing into .grad buffers. create_graph (higher-order) is supported via
-    the functional path only and raises here; use mxnet_tpu.functional.grad.
-    """
+    """Parity: mx.autograd.grad — return grads w.r.t. `variables` instead
+    of writing into .grad buffers. create_graph=True makes the returned
+    grads tape-resident so grad-of-grad composes (higher-order autograd
+    through the imperative tape; the functional mx.functional.grad is the
+    jax.grad-composition alternative)."""
     from .ndarray.ndarray import NDArray
 
-    if create_graph:
-        raise MXNetError(
-            "create_graph=True (higher-order grad through the imperative tape) "
-            "is not supported; use the functional API (mx.functional.grad), "
-            "which composes jax.grad arbitrarily deep"
-        )
+    if retain_graph is None:
+        retain_graph = create_graph
     single = isinstance(variables, NDArray)
     if single:
         variables = [variables]
@@ -278,7 +305,7 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         v._grad = None
     try:
         backward(heads, head_grads, retain_graph=bool(retain_graph),
-                 train_mode=train_mode)
+                 train_mode=train_mode, create_graph=create_graph)
         out = []
         for v in variables:
             if v._grad is None:
@@ -290,6 +317,124 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
             v._grad_req = req
             v._grad = g
     return out[0] if single else out
+
+
+def _is_float0(ct):
+    d = getattr(getattr(ct, "_data", ct), "dtype", None)
+    return d == jax.dtypes.float0
+
+
+def _backward_taped(heads, head_grads, train_mode):
+    """backward(create_graph=True): the reverse walk re-derives every
+    node's VJP through the op funnel (apply_op), so cotangents flow as
+    taped NDArrays and the leaf .grad buffers support further grads.
+    The graph is retained (a second-order backward re-enters the
+    original forward nodes)."""
+    from .ndarray.ndarray import NDArray
+    from .ops.registry import apply_op
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and isinstance(head_grads, NDArray):
+            head_grads = [head_grads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    if len(head_grads) != len(heads):
+        raise MXNetError("head_grads length mismatch")
+
+    cts = {}       # (id(node), out_idx) -> NDArray cotangent
+    leaf_cts = {}  # id(arr) -> (arr, NDArray cotangent)
+    head_nodes = []
+    for h, hg in zip(heads, head_grads):
+        entry = tape_entry(h)
+        if entry is None:
+            raise MXNetError(
+                "cannot differentiate: head is not on the tape "
+                "(was it computed under autograd.record()?)")
+        g = hg if hg is not None else NDArray(jnp.ones(h.shape, h.dtype))
+        if entry[0] == "leaf":
+            _accum_nd(leaf_cts, entry[1], g)
+            continue
+        _, node, idx = entry
+        key = (id(node), idx)
+        cts[key] = cts[key] + g if key in cts else g
+        head_nodes.append(node)
+
+    order = _toposort(head_nodes)
+    with _Scope(True, train_mode):
+        for node in reversed(order):
+            outs, missing = [], True
+            for i, (shape, dtype) in enumerate(node.out_avals):
+                ct = cts.pop((id(node), i), None)
+                if ct is None:
+                    ct = NDArray(jnp.zeros(shape, dtype))
+                else:
+                    missing = False
+                outs.append(ct)
+            if missing:
+                continue
+            if node.primal_fn is not None:
+                primal, n_in, multi = node.primal_fn, len(node.inputs),                     node.multi
+
+                def grad_fn(*args, _p=primal, _n=n_in, _m=multi):
+                    ins, cts_ = args[:_n], args[_n:]
+                    _, vjp = jax.vjp(_p, *ins)
+                    return tuple(vjp(tuple(cts_) if _m else cts_[0]))
+
+                in_cts = apply_op(f"grad[{node.name}]", grad_fn,
+                                  tuple(node.inputs) + tuple(outs))
+                if not isinstance(in_cts, tuple):
+                    in_cts = (in_cts,)
+            elif node.func_info is not None:
+                func, nd_positions, n_in = node.func_info
+                # recording scope active: the user backward's NDArray
+                # ops tape, same as the reference re-recording FGradient.
+                # backward returns one grad per forward input; the node's
+                # parents are the ND-array inputs only
+                in_grads = func.backward(*outs)
+                if isinstance(in_grads, NDArray):
+                    in_grads = (in_grads,)
+                if len(in_grads) != n_in:
+                    raise MXNetError(
+                        f"{type(func).__name__}.backward returned "
+                        f"{len(in_grads)} grads for {n_in} inputs")
+                in_cts = tuple(in_grads[i] for i in nd_positions)
+            else:
+                if node.vjp_fn is None:
+                    raise MXNetError(
+                        "tape already consumed; create_graph needs the "
+                        "retained graph (do not run a releasing "
+                        "backward first)")
+                raise MXNetError(
+                    f"node {node.name!r} is not re-differentiable "
+                    "(no primal recorded); higher-order grad supports "
+                    "funnel ops and autograd.Function nodes")
+            for parent, ct in zip(node.parents, in_cts):
+                if parent is None or ct is None or _is_float0(ct):
+                    continue
+                if parent[0] == "leaf":
+                    _accum_nd(leaf_cts, parent[1], ct)
+                else:
+                    _, pnode, pidx = parent
+                    key = (id(pnode), pidx)
+                    cts[key] = cts[key] + ct if key in cts else ct
+
+        for _, (arr, ct) in leaf_cts.items():
+            req = arr._grad_req
+            if req == "null":
+                continue
+            if req == "add" and arr._grad is not None:
+                arr._grad = arr._grad + ct
+            else:
+                arr._grad = ct if isinstance(ct, NDArray) else NDArray(ct)
+
+
+def _accum_nd(store, arr, ct):
+    key = id(arr)
+    if key in store:
+        store[key] = (arr, store[key][1] + ct)
+    else:
+        store[key] = (arr, ct)
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):
@@ -359,5 +504,6 @@ class Function:
                              else None for i in nd_positions)
 
             record_node(type(self).__name__, vjp_fn,
-                        [inputs[i] for i in nd_positions], outs)
+                        [inputs[i] for i in nd_positions], outs,
+                        func_info=(self, nd_positions, n_in))
         return outputs

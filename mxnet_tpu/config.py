@@ -50,6 +50,14 @@ KNOBS = {k.name: k for k in [
     # data pipeline
     Knob("MXTPU_DECODE_THREADS", int, 0,
          "io.ImageRecordIter decode thread count (0 = min(8, cores))"),
+    # autograd
+    Knob("MXTPU_TAPE_PRIMALS", bool, True,
+         "Keep each taped op's primal function + input buffers on the "
+         "tape so backward(create_graph=True) (higher-order grad) can "
+         "re-derive VJPs. Costs retention of input buffers that "
+         "residual-free ops (add/reshape/...) would otherwise free "
+         "before backward; set 0 on memory-constrained first-order "
+         "training (create_graph then raises)."),
     # bench knobs (bench.py)
     Knob("BENCH_WORKLOAD", str, "both",
          "bench.py workload: both|bert|bert_large|resnet50|gpt2_decode|"

@@ -110,6 +110,7 @@ class Estimator:
     def _fused_step(self, data, label):
         if self._train_step is None:
             from ....parallel import TrainStep
+            self.net(data[:1])  # finish any deferred shape inference
             self._train_step = TrainStep(
                 self.net, self.loss, self.trainer.optimizer, mesh=None)
         loss = self._train_step(data, label)

@@ -359,3 +359,8 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                                        std)
         auglist.append(_NormAug())
     return auglist
+
+# detection pipeline (parity: python/mxnet/image/detection.py)
+from .detection import (  # noqa: E402,F401
+    CreateDetAugmenter, DetAugmenter, DetBorrowAug, DetHorizontalFlipAug,
+    DetRandomCropAug, DetRandomPadAug, ImageDetIter)

@@ -1,0 +1,245 @@
+"""Detection data pipeline: box-aware augmenters + ImageDetIter.
+
+Reference parity: python/mxnet/image/detection.py (ImageDetIter,
+DetAugmenter family, CreateDetAugmenter). Boxes ride through every
+augmenter as normalized [class, x1, y1, x2, y2] rows (pad rows have
+class = -1), the exact layout multibox_target consumes — so the iterator
+feeds SSD training directly.
+
+Label wire format (im2rec detection convention): the IRHeader label
+vector is either a flat [cls, x1, y1, x2, y2] * N list, or the reference
+lst-style [header_width, object_width, (extra header...), objects...]
+prefix form; both are parsed.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..io.pipeline import ImageRecordIter
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
+           "DetRandomCropAug", "DetRandomPadAug", "CreateDetAugmenter",
+           "ImageDetIter"]
+
+
+class DetAugmenter:
+    """Joint (image, boxes) transform. img: (H, W, 3) uint8 numpy;
+    boxes: (N, 5) float32 normalized [cls, x1, y1, x2, y2], cls=-1 pads."""
+
+    def __call__(self, img, boxes):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift a color-only classification Augmenter (brightness/contrast/
+    saturation/lighting...) into the detection pipeline — geometry
+    unchanged, boxes pass through (parity: DetBorrowAug)."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, img, boxes):
+        from ..ndarray.ndarray import NDArray
+        out = self.augmenter(NDArray(img.astype(_np.float32)))
+        img = out.asnumpy() if hasattr(out, "asnumpy") else out
+        return _np.clip(img, 0, 255).astype(_np.uint8), boxes
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image and x-coordinates with probability p (parity:
+    DetHorizontalFlipAug)."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, img, boxes):
+        if _np.random.random() < self.p:
+            img = img[:, ::-1]
+            valid = boxes[:, 0] >= 0
+            x1 = boxes[:, 1].copy()
+            boxes = boxes.copy()
+            boxes[valid, 1] = 1.0 - boxes[valid, 3]
+            boxes[valid, 3] = 1.0 - x1[valid]
+        return img, boxes
+
+
+class DetRandomCropAug(DetAugmenter):
+    """IoU-constrained random crop (the SSD 'ssd_crop' recipe; parity:
+    DetRandomCropAug). Samples a crop whose coverage of at least one box
+    meets min_object_covered; boxes keep membership by center-in-crop,
+    are clipped and renormalized. Falls back to the full image when no
+    valid crop is found in max_attempts."""
+
+    def __init__(self, min_object_covered=0.3,
+                 aspect_ratio_range=(0.75, 1.333),
+                 area_range=(0.3, 1.0), max_attempts=30):
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+
+    def __call__(self, img, boxes):
+        H, W = img.shape[:2]
+        valid = boxes[:, 0] >= 0
+        if not valid.any():
+            return img, boxes
+        vb = boxes[valid, 1:5]
+        for _ in range(self.max_attempts):
+            area = _np.random.uniform(*self.area_range)
+            ar = _np.random.uniform(*self.aspect_ratio_range)
+            cw = min(1.0, _np.sqrt(area * ar))
+            ch = min(1.0, _np.sqrt(area / ar))
+            cx = _np.random.uniform(0, 1.0 - cw)
+            cy = _np.random.uniform(0, 1.0 - ch)
+            crop = _np.array([cx, cy, cx + cw, cy + ch])
+            ix1 = _np.maximum(vb[:, 0], crop[0])
+            iy1 = _np.maximum(vb[:, 1], crop[1])
+            ix2 = _np.minimum(vb[:, 2], crop[2])
+            iy2 = _np.minimum(vb[:, 3], crop[3])
+            inter = _np.clip(ix2 - ix1, 0, None) * \
+                _np.clip(iy2 - iy1, 0, None)
+            barea = (vb[:, 2] - vb[:, 0]) * (vb[:, 3] - vb[:, 1])
+            cover = inter / _np.maximum(barea, 1e-12)
+            if cover.max() < self.min_object_covered:
+                continue
+            # membership: box center inside the crop
+            cxs = (vb[:, 0] + vb[:, 2]) / 2
+            cys = (vb[:, 1] + vb[:, 3]) / 2
+            keep = ((cxs >= crop[0]) & (cxs <= crop[2])
+                    & (cys >= crop[1]) & (cys <= crop[3]))
+            if not keep.any():
+                continue
+            x1p, y1p = int(crop[0] * W), int(crop[1] * H)
+            x2p, y2p = int(crop[2] * W), int(crop[3] * H)
+            if x2p - x1p < 2 or y2p - y1p < 2:
+                continue
+            img2 = img[y1p:y2p, x1p:x2p]
+            out = _np.full_like(boxes, -1.0)
+            vi = _np.flatnonzero(valid)[keep]
+            nb = boxes[vi].copy()
+            nb[:, 1] = _np.clip((nb[:, 1] - crop[0]) / cw, 0, 1)
+            nb[:, 2] = _np.clip((nb[:, 2] - crop[1]) / ch, 0, 1)
+            nb[:, 3] = _np.clip((nb[:, 3] - crop[0]) / cw, 0, 1)
+            nb[:, 4] = _np.clip((nb[:, 4] - crop[1]) / ch, 0, 1)
+            out[:len(nb)] = nb
+            return img2, out
+        return img, boxes
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Zoom-out: place the image on a larger filled canvas (parity:
+    DetRandomPadAug; the SSD 'expand' trick for small objects)."""
+
+    def __init__(self, max_expand=2.0, pad_val=(127, 127, 127), p=0.5):
+        self.max_expand = max_expand
+        self.pad_val = pad_val
+        self.p = p
+
+    def __call__(self, img, boxes):
+        if _np.random.random() >= self.p or self.max_expand <= 1.0:
+            return img, boxes
+        H, W = img.shape[:2]
+        e = _np.random.uniform(1.0, self.max_expand)
+        nH, nW = int(H * e), int(W * e)
+        y0 = _np.random.randint(0, nH - H + 1)
+        x0 = _np.random.randint(0, nW - W + 1)
+        canvas = _np.empty((nH, nW, 3), img.dtype)
+        canvas[:] = _np.asarray(self.pad_val, img.dtype)
+        canvas[y0:y0 + H, x0:x0 + W] = img
+        out = boxes.copy()
+        valid = out[:, 0] >= 0
+        out[valid, 1] = (out[valid, 1] * W + x0) / nW
+        out[valid, 2] = (out[valid, 2] * H + y0) / nH
+        out[valid, 3] = (out[valid, 3] * W + x0) / nW
+        out[valid, 4] = (out[valid, 4] * H + y0) / nH
+        return canvas, out
+
+
+def CreateDetAugmenter(data_shape, rand_crop=0.0, rand_pad=0.0,
+                       rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0,
+                       min_object_covered=0.3,
+                       aspect_ratio_range=(0.75, 1.333),
+                       area_range=(0.3, 1.0), max_expand=2.0,
+                       pad_val=(127, 127, 127), max_attempts=30):
+    """Standard SSD augmentation list (parity: CreateDetAugmenter).
+    rand_crop/rand_pad are application probabilities."""
+    augs = []
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                area_range, max_attempts)
+
+        class _MaybeCrop(DetAugmenter):
+            def __call__(self, img, boxes):
+                if _np.random.random() < rand_crop:
+                    return crop(img, boxes)
+                return img, boxes
+
+        augs.append(_MaybeCrop())
+    if rand_pad > 0:
+        augs.append(DetRandomPadAug(max_expand, pad_val, p=rand_pad))
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug(0.5))
+    from . import (BrightnessJitterAug, ContrastJitterAug,
+                   SaturationJitterAug)
+    if brightness:
+        augs.append(DetBorrowAug(BrightnessJitterAug(brightness)))
+    if contrast:
+        augs.append(DetBorrowAug(ContrastJitterAug(contrast)))
+    if saturation:
+        augs.append(DetBorrowAug(SaturationJitterAug(saturation)))
+    return augs
+
+
+def _parse_det_label(label, width=5):
+    """IRHeader label vector → (N, 5) float32. Accepts the flat form and
+    the reference lst header form [hw, ow, ...extra..., objects...]."""
+    lab = _np.asarray(label, _np.float32).reshape(-1)
+    if lab.size >= 2 and lab[1] == width:
+        hw = int(lab[0])
+        # lst header form [header_width, obj_width, extra..., objects]:
+        # accept any header width whose removal leaves whole objects
+        if 2 <= hw <= lab.size and (lab.size - hw) % width == 0:
+            lab = lab[hw:]
+    if lab.size % width:
+        raise MXNetError(
+            f"detection label length {lab.size} not divisible by {width}")
+    return lab.reshape(-1, width)
+
+
+class ImageDetIter(ImageRecordIter):
+    """Detection data iterator over an im2rec RecordIO pack (parity:
+    image.ImageDetIter). Yields (data (B, 3, H, W) float32,
+    label (B, max_objs, 5) float32) with class=-1 pad rows — the exact
+    multibox_target input layout. Decode runs on the native libjpeg
+    thread pool; det augmenters transform image and boxes jointly."""
+
+    def __init__(self, path_imgrec, batch_size, data_shape,
+                 max_objs=8, label_width=5, det_aug_list=None, **kwargs):
+        if kwargs.pop("aug_list", None):
+            raise MXNetError("use det_aug_list (box-aware) with "
+                             "ImageDetIter")
+        super().__init__(path_imgrec, batch_size, data_shape, **kwargs)
+        self._max_objs = int(max_objs)
+        self._label_width = int(label_width)
+        self._det_augs = det_aug_list or []
+
+    def _decode_one(self, raw):
+        import cv2
+        header, img_bytes = self._unpack(raw)
+        img = self._decoder.decode(img_bytes)
+        boxes = _parse_det_label(header.label, self._label_width)
+        padded = _np.full((self._max_objs, self._label_width), -1.0,
+                          _np.float32)
+        n = min(len(boxes), self._max_objs)
+        padded[:n] = boxes[:n]
+        for aug in self._det_augs:
+            img, padded = aug(img, padded)
+        c, H, W = self.data_shape
+        if img.shape[0] != H or img.shape[1] != W:
+            img = cv2.resize(img, (W, H), interpolation=cv2.INTER_LINEAR)
+        img = img.transpose(2, 0, 1)  # uint8 over the wire (see pipeline)
+        if img.dtype != _np.uint8:
+            img = img.astype(_np.float32)
+        return img, padded

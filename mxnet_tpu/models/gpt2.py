@@ -389,3 +389,92 @@ class GPT2ForCausalLM(HybridBlock):
         else:
             out = fn(param_datas, ids, key)
         return NDArray(out)
+
+
+def gpt2_pp_functions(model, n_stages):
+    """Split a GPT2ForCausalLM into the (embed_fn, stage_fn,
+    head_loss_fn) functional triple `parallel.PPTrainStep` consumes,
+    plus its parameter pytrees: returns (embed_fn, stage_fn,
+    head_loss_fn, embed_params, stacked_body_params, head_params, tied).
+
+    Stage s owns num_layers/n_stages consecutive GPT2Blocks; the token+
+    position embedding runs on stage 0 and the final-LN + weight-tied LM
+    head + causal cross-entropy on the last stage (tied=("wte", "wte")
+    tells PPTrainStep to sum the two wte gradients and mirror the master
+    copy). Dropout must be 0 (the pipeline recomputes stages for the
+    1F1B backward; a stochastic forward would not reproduce).
+
+    Parity note: the reference has no pipeline parallelism at all —
+    SURVEY.md §2.4 'Model parallelism (manual, group2ctx)'; this is the
+    brief's first-class TPU replacement (SURVEY §7.2 M8).
+    """
+    from .. import autograd as _ag
+    from ..parallel import stack_stage_params
+
+    c = model.config
+    if c.dropout or c.attention_dropout:
+        raise MXNetError("gpt2_pp_functions: build the model with "
+                         "dropout=0 (pipeline recompute must be "
+                         "deterministic)")
+    backbone = model.backbone
+    blocks = backbone.blocks()
+    L = len(blocks)
+    if L % n_stages:
+        raise MXNetError(f"{L} layers not divisible by {n_stages} stages")
+    k = L // n_stages
+
+    def block_params(b):
+        return {name: p.data()._data
+                for name, p in b.collect_params().items()}
+
+    stage_trees = [[block_params(b) for b in blocks[s * k:(s + 1) * k]]
+                   for s in range(n_stages)]
+    stacked = stack_stage_params(stage_trees)
+    template = blocks[:k]
+
+    def apply_block(b, params, h):
+        ps = b.collect_params()
+        saved = [(p, p._data) for p in ps.values()]
+        try:
+            for name, p in ps.items():
+                arr = NDArray(params[name])
+                arr._grad_req = "null"
+                p._data = arr
+            with _ag._Scope(False, False):
+                out, _ = b.forward(NDArray(h), None, None)
+            return out._data
+        finally:
+            for p, d in saved:
+                p._data = d
+
+    def stage_fn(stage_params, h):
+        for i in range(k):
+            h = apply_block(template[i], stage_params[i], h)
+        return h
+
+    wte = backbone.word_embed.weight.data()._data
+    embed_params = {"wte": wte,
+                    "wpe": backbone.position_embed.weight.data()._data}
+    head_params = {"g": backbone.ln_f.gamma.data()._data,
+                   "b": backbone.ln_f.beta.data()._data,
+                   "wte": wte}
+    eps = c.layer_norm_eps
+
+    def embed_fn(ep, ids):
+        t = ids.shape[1]
+        return ep["wte"][ids] + ep["wpe"][:t][None]
+
+    def head_loss_fn(hp, h, labels):
+        x32 = h.astype(jnp.float32)
+        mean = x32.mean(-1, keepdims=True)
+        var = x32.var(-1, keepdims=True)
+        xn = (x32 - mean) * jax.lax.rsqrt(var + eps)
+        xn = xn * hp["g"].astype(jnp.float32) + hp["b"].astype(jnp.float32)
+        logits = xn @ hp["wte"].astype(jnp.float32).T
+        lp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(lp, labels[..., None].astype(jnp.int32),
+                                   -1)
+        return nll.mean().astype(jnp.float32)
+
+    return (embed_fn, stage_fn, head_loss_fn, embed_params, stacked,
+            head_params, [("wte", "wte")])

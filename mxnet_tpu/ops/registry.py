@@ -26,6 +26,16 @@ from ..base import MXNetError, Registry
 
 OPS = Registry("operator")
 
+_TAPE_PRIMALS = None
+
+
+def _tape_primals():
+    global _TAPE_PRIMALS
+    if _TAPE_PRIMALS is None:
+        from ..config import get as _cfg
+        _TAPE_PRIMALS = bool(_cfg("MXTPU_TAPE_PRIMALS"))
+    return _TAPE_PRIMALS
+
 
 def _profiler_active():
     # zero-overhead when the profiler module was never imported
@@ -72,7 +82,12 @@ def apply_op(name, closed_fn, array_args, out=None, nodiff=False):
         _prof.record_op(name, _time.perf_counter() - t0)
     outs = [NDArray(d) for d in out_list]
     if rec:
-        record_node(name, vjp_fn, array_args, outs, multi=multi)
+        # closed_fn rides on the node so backward(create_graph=True) can
+        # re-derive this op's VJP as taped ops (higher-order autograd).
+        # MXTPU_TAPE_PRIMALS=0 drops it (and the input-buffer retention
+        # it costs) for memory-constrained first-order training.
+        record_node(name, vjp_fn, array_args, outs, multi=multi,
+                    primal_fn=closed_fn if _tape_primals() else None)
     result = tuple(outs) if multi else outs[0]
     if out is not None:
         _write_out(out, result)

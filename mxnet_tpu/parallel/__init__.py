@@ -12,7 +12,8 @@ from .rules import (  # noqa: F401
     ShardingRules, apply_sharding_rules, ep_rules, fsdp_rules,
     megatron_dense_rules)
 from .sp import ring_attention, sp_enabled, ulysses_attention  # noqa: F401
-from .pp import gpipe, stack_stage_params  # noqa: F401
+from .pp import (PPTrainStep, gpipe, pipeline_grads,  # noqa: F401
+                 pipeline_loss, stack_stage_params)
 from .moe import (  # noqa: F401
     all_to_all_tokens, moe_dispatch_combine, top_k_gating)
 from .step import EvalStep, TrainStep  # noqa: F401

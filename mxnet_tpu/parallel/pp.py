@@ -25,7 +25,8 @@ from jax import lax
 from ..base import MXNetError
 from .mesh import AXIS_PP, PartitionSpec, current_mesh, shard_map_compat
 
-__all__ = ["gpipe", "stack_stage_params"]
+__all__ = ["gpipe", "stack_stage_params", "pipeline_loss",
+           "pipeline_grads", "PPTrainStep"]
 
 
 def stack_stage_params(stage_param_trees):
@@ -93,3 +94,376 @@ def gpipe(stage_fn, stacked_params, x, n_microbatches, mesh=None,
                           in_specs=(PartitionSpec(axis), PartitionSpec()),
                           out_specs=PartitionSpec(), check_rep=False)
     return fn(stacked_params, x)
+
+
+# ---------------------------------------------------------------------------
+# Full-model pipeline: embedding / repeated body / head+loss stage groups
+# ---------------------------------------------------------------------------
+#
+# Real LMs are not identical-stages-only: the first stage embeds tokens,
+# the last stage projects to the vocabulary and computes the loss. Here the
+# rotating activation keeps ONE shape (mb, ...) — token ids enter stage 0
+# as data, the head collapses to a per-microbatch scalar loss on the last
+# stage — so embed and head live INSIDE the pipeline without breaking the
+# ppermute contract. lax.cond keeps the embed/head work off the stages
+# that don't own it (SPMD code, per-device control flow).
+#
+# Two schedules:
+#   * schedule="gpipe": forward pipeline as one scan; XLA autodiff
+#     produces the reverse pipeline (all M microbatch activations live —
+#     the GPipe memory profile). Differentiable, drop into jax.grad.
+#   * pipeline_grads(...): explicit 1F1B with per-stage recompute — the
+#     warmup/steady/cooldown schedule, at most P microbatches in flight
+#     per device, backward interleaved with forward. Activation memory
+#     O(P·mb) instead of O(M·mb); param grads accumulate in the scan
+#     carry. Returns (loss, grads) directly (it IS the backward).
+
+def _mb_split(x, M):
+    return x.reshape((M, x.shape[0] // M) + x.shape[1:])
+
+
+def pipeline_loss(embed_fn, stage_fn, head_loss_fn, embed_params,
+                  stacked_params, head_params, x, y, n_microbatches,
+                  mesh=None, axis=AXIS_PP):
+    """Mean loss of embed → P stacked body stages → head, pipelined over
+    the mesh's "pp" axis with the GPipe schedule. Differentiable (reverse
+    pipeline via XLA autodiff).
+
+    embed_fn(embed_params, x_mb) -> h (mb, ...);
+    stage_fn(body_params, h) -> h (same shape);
+    head_loss_fn(head_params, h, y_mb) -> scalar mean loss over the
+    microbatch. x, y: (B, ...) global batch arrays.
+    """
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        raise MXNetError(f"pipeline needs a mesh with a {axis!r} axis")
+    P = mesh.shape[axis]
+    n_dp = mesh.shape["dp"] if "dp" in mesh.axis_names else 1
+    B = x.shape[0]
+    M = int(n_microbatches)
+    if B % max(n_dp, 1):
+        raise MXNetError(f"batch {B} not divisible over dp={n_dp}")
+    if (B // max(n_dp, 1)) % M:
+        raise MXNetError(
+            f"per-dp-shard batch {B // max(n_dp, 1)} not divisible into "
+            f"{M} microbatches")
+
+    def local(eparams, params, hparams, xs, ys):
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        stage = lax.axis_index(axis)
+        perm = [(i, (i + 1) % P) for i in range(P)]
+        xs = _mb_split(xs, M)
+        ys = _mb_split(ys, M)
+        probe = embed_fn(eparams, xs[0])
+        state0 = jnp.zeros_like(probe)
+
+        def step(carry, t):
+            state, loss_acc = carry
+            h_in = lax.cond(stage == 0,
+                            lambda: embed_fn(eparams, xs[t % M]),
+                            lambda: state)
+            out = stage_fn(params, h_in)
+            take = (stage == P - 1) & (t >= P - 1)
+            mb_loss = lax.cond(
+                take,
+                lambda: head_loss_fn(
+                    hparams, out,
+                    ys[(t - (P - 1)) % M]).astype(jnp.float32),
+                lambda: jnp.zeros((), jnp.float32))
+            state = lax.ppermute(out, axis, perm)
+            return (state, loss_acc + mb_loss), None
+
+        (_, loss_sum), _ = lax.scan(step, (state0, jnp.zeros((),
+                                                            jnp.float32)),
+                                    jnp.arange(M + P - 1))
+        # loss lives on the last stage; psum replicates (others hold 0)
+        loss = lax.psum(loss_sum, axis) / M
+        if dp:
+            loss = lax.pmean(loss, dp)
+        return loss
+
+    dp = "dp" if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 else None
+    bspec = PartitionSpec(dp) if dp else PartitionSpec()
+    fn = shard_map_compat(
+        local, mesh=mesh,
+        in_specs=(PartitionSpec(), PartitionSpec(axis), PartitionSpec(),
+                  bspec, bspec),
+        out_specs=PartitionSpec(), check_rep=False)
+    return fn(embed_params, stacked_params, head_params, x, y)
+
+
+def pipeline_grads(embed_fn, stage_fn, head_loss_fn, embed_params,
+                   stacked_params, head_params, x, y, n_microbatches,
+                   mesh=None, axis=AXIS_PP):
+    """Interleaved forward/backward (1F1B-style) pipeline training step
+    with per-stage recompute: returns (mean_loss, embed_grads,
+    stacked_body_grads, head_grads) — it IS the backward, no outer
+    jax.grad.
+
+    Schedule: stage p forwards microbatch m at step m+p and backwards it
+    at step m + 2(P-1) - p; in steady state every device runs one
+    forward and one backward per step, cotangents rotating stage→stage-1
+    while activations rotate stage→stage+1. Each backward recomputes its
+    stage's VJP from the SAVED INPUT activation (Megatron-style
+    activation checkpointing), so activation residency is O(P) saved
+    microbatch inputs per device instead of GPipe-autodiff's O(M).
+    Gradients accumulate in the scan carry in f32: body grads stay
+    sharded over "pp" (one stage's slice each), embed/head grads are
+    psum-replicated on exit.
+    """
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        raise MXNetError(f"pipeline needs a mesh with a {axis!r} axis")
+    P = mesh.shape[axis]
+    n_dp = mesh.shape["dp"] if "dp" in mesh.axis_names else 1
+    B = x.shape[0]
+    M = int(n_microbatches)
+    if B % max(n_dp, 1):
+        raise MXNetError(f"batch {B} not divisible over dp={n_dp}")
+    if (B // max(n_dp, 1)) % M:
+        raise MXNetError(
+            f"per-dp-shard batch {B // max(n_dp, 1)} not divisible into "
+            f"{M} microbatches")
+    if M < 1:
+        raise MXNetError("need at least one microbatch")
+    DEPTH = 2 * P  # stage p holds a microbatch input 2(P-1-p) steps
+
+    def local(eparams, params, hparams, xs, ys):
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        stage = lax.axis_index(axis)
+        fwd_perm = [(i, (i + 1) % P) for i in range(P)]
+        bwd_perm = [((i + 1) % P, i) for i in range(P)]
+        xs = _mb_split(xs, M)
+        ys = _mb_split(ys, M)
+        probe = embed_fn(eparams, xs[0])
+        act_shape, act_dtype = probe.shape, probe.dtype
+
+        f32tree = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda a: jnp.zeros(a.shape, jnp.float32), t)
+        zero_e, zero_b, zero_h = f32tree(eparams), f32tree(params), \
+            f32tree(hparams)
+        zero_act = jnp.zeros(act_shape, act_dtype)
+
+        n_steps = M + 2 * P - 2
+
+        def step(carry, t):
+            (state_f, state_b, saved, ge, gb, gh, loss_acc) = carry
+            # ---- forward: stage p handles microbatch m = t - p --------
+            fwd_m = t - stage
+            do_fwd = (fwd_m >= 0) & (fwd_m < M)
+
+            def fwd_branch():
+                h_in = lax.cond(stage == 0,
+                                lambda: embed_fn(eparams, xs[t % M]),
+                                lambda: state_f)
+                return h_in, stage_fn(params, h_in)
+
+            h_in, out = lax.cond(do_fwd, fwd_branch,
+                                 lambda: (zero_act, zero_act))
+            saved = lax.cond(do_fwd,
+                             lambda: saved.at[fwd_m % DEPTH].set(h_in),
+                             lambda: saved)
+            state_f_new = lax.ppermute(out, axis, fwd_perm)
+
+            # ---- backward: stage p backs m = t - 2(P-1) + p -----------
+            bwd_m = t - 2 * (P - 1) + stage
+            do_bwd = (bwd_m >= 0) & (bwd_m < M)
+
+            def bwd_branch():
+                h_saved = saved[bwd_m % DEPTH]
+
+                def stage_loss(params_, eparams_, hparams_, h_in_):
+                    h_in2 = lax.cond(
+                        stage == 0,
+                        lambda: embed_fn(eparams_, xs[bwd_m % M]),
+                        lambda: h_in_)
+                    out_ = stage_fn(params_, h_in2)
+                    return lax.cond(
+                        stage == P - 1,
+                        lambda: head_loss_fn(
+                            hparams_, out_,
+                            ys[bwd_m % M]).astype(jnp.float32),
+                        lambda: jnp.sum(
+                            out_.astype(jnp.float32)
+                            * state_b.astype(jnp.float32)))
+
+                l, vjp = jax.vjp(stage_loss, params, eparams, hparams,
+                                 h_saved)
+                db, de, dh, dx = vjp(jnp.ones((), l.dtype))
+                cast32 = lambda tr: jax.tree_util.tree_map(  # noqa: E731
+                    lambda a: a.astype(jnp.float32), tr)
+                return l, cast32(db), cast32(de), cast32(dh), \
+                    dx.astype(act_dtype)
+
+            def no_bwd():
+                return (jnp.zeros((), jnp.float32), zero_b, zero_e,
+                        zero_h, zero_act)
+
+            l, db, de, dh, dx = lax.cond(do_bwd, bwd_branch, no_bwd)
+            loss_acc = loss_acc + jnp.where(
+                do_bwd & (stage == P - 1), l, 0.0)
+            tadd = lambda a, b: jax.tree_util.tree_map(  # noqa: E731
+                lambda p_, q_: p_ + q_, a, b)
+            ge, gb, gh = tadd(ge, de), tadd(gb, db), tadd(gh, dh)
+            state_b_new = lax.ppermute(dx, axis, bwd_perm)
+            return (state_f_new, state_b_new, saved, ge, gb, gh,
+                    loss_acc), None
+
+        saved0 = jnp.zeros((DEPTH,) + act_shape, act_dtype)
+        carry0 = (zero_act, zero_act, saved0, zero_e, zero_b, zero_h,
+                  jnp.zeros((), jnp.float32))
+        (_, _, _, ge, gb, gh, loss_sum), _ = lax.scan(
+            step, carry0, jnp.arange(n_steps))
+        loss = lax.psum(loss_sum, axis) / M
+        ge = jax.tree_util.tree_map(lambda g: lax.psum(g, axis) / M, ge)
+        gh = jax.tree_util.tree_map(lambda g: lax.psum(g, axis) / M, gh)
+        gb = jax.tree_util.tree_map(lambda g: g[None] / M, gb)
+        if dp:  # data parallelism: mean over the dp replicas
+            loss = lax.pmean(loss, dp)
+            ge = jax.tree_util.tree_map(lambda g: lax.pmean(g, dp), ge)
+            gh = jax.tree_util.tree_map(lambda g: lax.pmean(g, dp), gh)
+            gb = jax.tree_util.tree_map(lambda g: lax.pmean(g, dp), gb)
+        return loss, ge, gb, gh
+
+    dp = "dp" if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 else None
+    bspec = PartitionSpec(dp) if dp else PartitionSpec()
+    fn = shard_map_compat(
+        local, mesh=mesh,
+        in_specs=(PartitionSpec(), PartitionSpec(axis), PartitionSpec(),
+                  bspec, bspec),
+        out_specs=(PartitionSpec(), PartitionSpec(),
+                   PartitionSpec(axis), PartitionSpec()),
+        check_rep=False)
+    return fn(embed_params, stacked_params, head_params, x, y)
+
+
+class PPTrainStep:
+    """Pipeline-parallel fused training step: pipeline_grads (1F1B with
+    recompute) or grad-of-pipeline_loss (GPipe) + the optimizer, compiled
+    into ONE program over a pp(×dp) mesh — the pipeline counterpart of
+    parallel.TrainStep (SURVEY.md §7.2 M8: "PP composes with the train
+    step").
+
+    Functional interface: the model is (embed_fn, stage_fn,
+    head_loss_fn) over param pytrees (see models adapters / tests for
+    extracting these from Gluon blocks). Parameters stay device-resident
+    and donated; body params are sharded over "pp"; the batch shards
+    over "dp" when the mesh has one.
+
+    tied: optional list of (embed_path, head_path) leaf-key tuples whose
+    gradients are summed and applied once to the EMBED copy, with the
+    head copy mirrored (weight tying, e.g. GPT-2's lm head).
+    """
+
+    def __init__(self, embed_fn, stage_fn, head_loss_fn, embed_params,
+                 stacked_params, head_params, optimizer, n_microbatches,
+                 mesh=None, schedule="1f1b", tied=None):
+        from .mesh import named_sharding
+        self.mesh = mesh if mesh is not None else current_mesh()
+        if self.mesh is None or AXIS_PP not in self.mesh.axis_names:
+            raise MXNetError("PPTrainStep needs a mesh with a 'pp' axis")
+        if schedule not in ("1f1b", "gpipe"):
+            raise MXNetError(f"unknown schedule {schedule!r}")
+        if not optimizer.fused_supported:
+            raise MXNetError(
+                f"{type(optimizer).__name__} has no functional path")
+        self._fns = (embed_fn, stage_fn, head_loss_fn)
+        self.optimizer = optimizer
+        self.M = int(n_microbatches)
+        self.schedule = schedule
+        self.tied = list(tied or [])
+        pp_spec = named_sharding(PartitionSpec(AXIS_PP), mesh=self.mesh)
+        repl = named_sharding(PartitionSpec(), mesh=self.mesh)
+        # own copies: the step DONATES its param buffers, and device_put
+        # may alias the caller's arrays (same pattern as TrainStep)
+        put = lambda a, s_: jax.device_put(jnp.copy(a), s_)  # noqa: E731
+        self._eparams = jax.tree_util.tree_map(
+            lambda a: put(a, repl), embed_params)
+        self._bparams = jax.tree_util.tree_map(
+            lambda a: put(a, pp_spec), stacked_params)
+        self._hparams = jax.tree_util.tree_map(
+            lambda a: put(a, repl), head_params)
+        mkstate = lambda tree, spec: jax.tree_util.tree_map(  # noqa: E731
+            lambda a: tuple(jax.device_put(s_, spec)
+                            for s_ in optimizer.init_state_arrays(a)),
+            tree)
+        self._estate = mkstate(embed_params, repl)
+        self._bstate = mkstate(stacked_params, pp_spec)
+        # tied head copies are MIRRORED from the embed master each step —
+        # they carry no optimizer state and skip the (discarded) update
+        self._tied_h = {h for _, h in self.tied}
+        self._hstate = mkstate({k: v for k, v in head_params.items()
+                                if k not in self._tied_h}, repl)
+        self._t = jnp.zeros((), jnp.int32)
+        self._jitted = None
+
+    def _build(self):
+        embed_fn, stage_fn, head_loss_fn = self._fns
+        opt = self.optimizer
+        mesh, M, schedule, tied = (self.mesh, self.M, self.schedule,
+                                   self.tied)
+        tied_h = self._tied_h
+
+        def step_fn(eparams, bparams, hparams, estate, bstate, hstate,
+                    t, lr, wd, x, y):
+            t = t + 1
+            if schedule == "1f1b":
+                loss, ge, gb, gh = pipeline_grads(
+                    embed_fn, stage_fn, head_loss_fn, eparams, bparams,
+                    hparams, x, y, M, mesh=mesh)
+            else:
+                def loss_of(e, b, h):
+                    return pipeline_loss(embed_fn, stage_fn,
+                                         head_loss_fn, e, b, h, x, y, M,
+                                         mesh=mesh)
+                loss, (ge, gb, gh) = jax.value_and_grad(
+                    loss_of, argnums=(0, 1, 2))(eparams, bparams,
+                                                hparams)
+            for e_key, h_key in tied:
+                ge[e_key] = ge[e_key] + gh[h_key].astype(ge[e_key].dtype)
+            gh = {k: v for k, v in gh.items() if k not in tied_h}
+            h_mirror = {k: v for k, v in hparams.items() if k in tied_h}
+            hparams = {k: v for k, v in hparams.items()
+                       if k not in tied_h}
+
+            def apply_tree(params, grads, states):
+                leaves_p, treedef = jax.tree_util.tree_flatten(params)
+                leaves_g = treedef.flatten_up_to(grads)
+                leaves_s = treedef.flatten_up_to(states)
+                new_p, new_s = [], []
+                for p_, g_, s_ in zip(leaves_p, leaves_g, leaves_s):
+                    np_, ns_ = opt.apply_arrays(p_, g_.astype(p_.dtype),
+                                                tuple(s_), lr, wd, t)
+                    new_p.append(np_)
+                    new_s.append(ns_)
+                return (jax.tree_util.tree_unflatten(treedef, new_p),
+                        jax.tree_util.tree_unflatten(treedef, new_s))
+
+            eparams, estate = apply_tree(eparams, ge, estate)
+            bparams, bstate = apply_tree(bparams, gb, bstate)
+            hparams, hstate = apply_tree(hparams, gh, hstate)
+            for e_key, h_key in tied:  # mirror the tied master copy
+                hparams[h_key] = eparams[e_key].astype(
+                    h_mirror[h_key].dtype)
+            return (eparams, bparams, hparams, estate, bstate, hstate,
+                    t, loss)
+
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+
+    def __call__(self, x, y):
+        if self._jitted is None:
+            self._jitted = self._build()
+        lr = jnp.asarray(float(self.optimizer.learning_rate), jnp.float32)
+        wd = jnp.asarray(float(self.optimizer.wd), jnp.float32)
+        out = self._jitted(self._eparams, self._bparams, self._hparams,
+                           self._estate, self._bstate, self._hstate,
+                           self._t, lr, wd, jnp.asarray(x),
+                           jnp.asarray(y))
+        (self._eparams, self._bparams, self._hparams, self._estate,
+         self._bstate, self._hstate, self._t, loss) = out
+        return loss
+
+    @property
+    def params(self):
+        return self._eparams, self._bparams, self._hparams
+

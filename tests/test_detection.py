@@ -211,6 +211,7 @@ def test_roi_align_identity_box():
     assert np.isfinite(big).all()
 
 
+@pytest.mark.slow
 def test_ssd_forward_and_loss():
     from mxnet_tpu.models.vision import ssd_512_resnet50_v1_voc
     from mxnet_tpu.models.vision.ssd import SSDMultiBoxLoss
@@ -247,6 +248,7 @@ def test_ssd_forward_and_loss():
     assert det.shape == (2, N, 6)
 
 
+@pytest.mark.slow
 def test_ssd_overfits_single_image():
     """Convergence smoke: SSD must drive its multibox loss down on one
     fixed image+boxes (the detection analog of the zoo's convergence

@@ -55,6 +55,7 @@ def test_cached_forward_matches_full():
     np.testing.assert_allclose(step, full, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("paged", [False, True])
 def test_greedy_decode_matches_full_recompute(paged):
     net, cfg = _tiny()

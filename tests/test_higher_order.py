@@ -1,0 +1,165 @@
+"""Higher-order autograd through the imperative tape.
+
+Parity: python/mxnet/autograd.py grad(create_graph=True) and the
+reference's dedicated tests/python/unittest/test_higher_order_grad.py
+(sin/cos/log/sigmoid/... second derivatives). Mechanism here: backward
+re-derives each node's VJP through the op funnel as taped ops
+(autograd._backward_taped), so grads compose arbitrarily deep — and must
+agree with the functional path (mx.functional.grad ~ jax.grad)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.base import MXNetError
+
+
+def _x(vals=(0.3, 0.7, 1.1, 1.9)):
+    x = mx.nd.array(np.asarray(vals, np.float32))
+    x.attach_grad()
+    return x
+
+
+# (op, f, f'', domain) — the reference test_higher_order_grad.py cases
+CASES = [
+    ("sin", lambda x: mx.nd.sin(x), lambda v: -np.sin(v), (0.2, 2.5)),
+    ("cos", lambda x: mx.nd.cos(x), lambda v: -np.cos(v), (0.2, 2.5)),
+    ("log", lambda x: mx.nd.log(x), lambda v: -1.0 / v ** 2, (0.3, 3.0)),
+    ("exp", lambda x: mx.nd.exp(x), lambda v: np.exp(v), (-1.0, 1.5)),
+    ("sqrt", lambda x: mx.nd.sqrt(x), lambda v: -0.25 * v ** -1.5,
+     (0.3, 3.0)),
+    ("sigmoid", lambda x: mx.nd.sigmoid(x),
+     lambda v: (s := 1 / (1 + np.exp(-v))) * (1 - s) * (1 - 2 * s),
+     (-2.0, 2.0)),
+    ("tanh", lambda x: mx.nd.tanh(x),
+     lambda v: -2 * np.tanh(v) * (1 - np.tanh(v) ** 2), (-1.5, 1.5)),
+    ("square", lambda x: x * x, lambda v: np.full_like(v, 2.0),
+     (-2.0, 2.0)),
+    ("reciprocal", lambda x: 1.0 / x, lambda v: 2.0 / v ** 3, (0.4, 2.0)),
+]
+
+
+@pytest.mark.parametrize("name,f,d2,dom", CASES, ids=[c[0] for c in CASES])
+def test_second_derivative(name, f, d2, dom):
+    v = np.linspace(dom[0], dom[1], 9).astype(np.float32)
+    x = mx.nd.array(v)
+    x.attach_grad()
+    with ag.record():
+        y = f(x)
+        g1 = ag.grad(y, x, create_graph=True)
+        s = g1.sum()
+    g2 = ag.grad(s, x)
+    np.testing.assert_allclose(g2.asnumpy(), d2(v), rtol=2e-4, atol=2e-5)
+
+
+def test_third_order():
+    v = np.linspace(0.3, 1.2, 5).astype(np.float32)
+    x = mx.nd.array(v)
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.exp(x * x)
+        g1 = ag.grad(y, x, create_graph=True)
+        g2 = ag.grad(g1.sum(), x, create_graph=True)
+        g3 = ag.grad(g2.sum(), x)
+    want = np.exp(v ** 2) * (12 * v + 8 * v ** 3)
+    np.testing.assert_allclose(g3.asnumpy(), want, rtol=2e-4)
+
+
+def test_matches_functional_grad():
+    """Tape-route grad-of-grad == mx.functional.grad composition."""
+    from mxnet_tpu import functional as F
+
+    v = np.linspace(-1.0, 1.0, 7).astype(np.float32)
+
+    def f(x):
+        return (mx.nd.sigmoid(x) * mx.nd.sin(x)).sum()
+
+    x = mx.nd.array(v)
+    x.attach_grad()
+    with ag.record():
+        y = f(x)
+        g1 = ag.grad(y, x, create_graph=True)
+        s1 = g1.sum()
+    g2 = ag.grad(s1, x)
+
+    g2_fn = F.grad(lambda t: F.grad(f)(t).sum())(mx.nd.array(v))
+    np.testing.assert_allclose(g2.asnumpy(), g2_fn.asnumpy(), rtol=2e-4,
+                               atol=1e-5)
+
+
+def test_backward_create_graph_writes_taped_grads():
+    """backward(create_graph=True) leaves .grad on the tape."""
+    x = _x()
+    with ag.record():
+        y = (x * x * x).sum()
+        ag.backward(y, create_graph=True)
+        g = x.grad
+        assert g is not None
+        s = (g * g).sum()          # ||3x^2||^2 — still recording
+    x2 = x.asnumpy()
+    g2 = ag.grad(s, x)
+    # d/dx sum((3x^2)^2) = 36 x^3
+    np.testing.assert_allclose(g2.asnumpy(), 36 * x2 ** 3, rtol=2e-4)
+
+
+def test_gradient_penalty_training_pattern():
+    """The canonical use: WGAN-GP style ||∂y/∂x||² penalty trained with a
+    second backward through a Dense layer."""
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(1, in_units=4)
+    mx.rng.seed(0)
+    net.initialize(mx.init.Normal(0.5))
+    x = mx.nd.array(np.random.default_rng(0).standard_normal((8, 4)),
+                    dtype="float32")
+    x.attach_grad()
+    with ag.record():
+        y = net(x).sum()
+        gx = ag.grad(y, x, create_graph=True)
+        penalty = (gx * gx).sum()
+        ag.backward(penalty)
+    w_grad = net.weight.grad()  # Parameter.grad() is a method
+    # y = sum(xW^T + b) -> dy/dx = 1·W broadcast; penalty = B*||W||^2,
+    # d penalty/dW = 2*B*W
+    np.testing.assert_allclose(w_grad.asnumpy(),
+                               2 * 8 * net.weight.data().asnumpy(),
+                               rtol=2e-4)
+
+
+def test_function_node_higher_order():
+    """User autograd.Function backward is re-taped under create_graph."""
+
+    class Cube(ag.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return dy * 3.0 * x * x
+
+    v = np.asarray([0.5, 1.0, 2.0], np.float32)
+    x = mx.nd.array(v)
+    x.attach_grad()
+    with ag.record():
+        y = Cube()(x).sum()
+        g1 = ag.grad(y, x, create_graph=True)
+        s1 = g1.sum()
+    g2 = ag.grad(s1, x)
+    np.testing.assert_allclose(g2.asnumpy(), 6 * v, rtol=2e-4)
+
+
+def test_first_order_unchanged():
+    """create_graph=False keeps the releasing fast path (second backward
+    without retain_graph errors, as before)."""
+    x = _x()
+    with ag.record():
+        y = (x * x).sum()
+    ag.backward(y)
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(),
+                               rtol=1e-6)
+    with ag.record():
+        y = (x * x).sum()
+    ag.backward(y)
+    with pytest.raises(MXNetError):
+        ag.backward(y)

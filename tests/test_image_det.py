@@ -1,0 +1,135 @@
+"""Detection data pipeline: ImageDetIter + box-aware augmenters.
+
+Parity: python/mxnet/image/detection.py (ImageDetIter, DetAugmenter
+family, CreateDetAugmenter)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.image import (CreateDetAugmenter, DetHorizontalFlipAug,
+                             DetRandomCropAug, DetRandomPadAug,
+                             ImageDetIter)
+from mxnet_tpu.image.detection import _parse_det_label
+
+cv2 = pytest.importorskip("cv2")
+
+
+def _boxes(*rows):
+    return np.asarray(rows, np.float32)
+
+
+def test_parse_det_label_forms():
+    flat = np.asarray([1, .1, .2, .3, .4, 2, .5, .5, .9, .9], np.float32)
+    np.testing.assert_allclose(_parse_det_label(flat),
+                               flat.reshape(2, 5))
+    # reference lst header form [header_width, obj_width, ...objects]
+    hdr = np.concatenate([[2, 5], flat]).astype(np.float32)
+    np.testing.assert_allclose(_parse_det_label(hdr), flat.reshape(2, 5))
+    with pytest.raises(MXNetError):
+        _parse_det_label(np.ones(7, np.float32))
+
+
+def test_flip_tracks_pixels():
+    img = np.zeros((40, 60, 3), np.uint8)
+    img[10:20, 6:18] = 255  # object pixels
+    boxes = _boxes([3, 0.1, 0.25, 0.3, 0.5], [-1, -1, -1, -1, -1])
+    aug = DetHorizontalFlipAug(p=1.0)
+    img2, b2 = aug(img, boxes)
+    # box follows the pixels
+    x1, x2 = b2[0, 1], b2[0, 3]
+    np.testing.assert_allclose([x1, x2], [0.7, 0.9], atol=1e-6)
+    cols = np.flatnonzero(img2[:, :, 0].any(axis=0))
+    assert cols.min() == pytest.approx(x1 * 60, abs=1.0)
+    assert cols.max() == pytest.approx(x2 * 60 - 1, abs=1.0)
+    # pad row untouched
+    assert (b2[1] == -1).all()
+
+
+def test_random_crop_keeps_covered_boxes():
+    np.random.seed(0)
+    img = np.zeros((80, 80, 3), np.uint8)
+    img[20:60, 20:60] = 200
+    boxes = _boxes([1, 0.25, 0.25, 0.75, 0.75])
+    aug = DetRandomCropAug(min_object_covered=0.5,
+                           area_range=(0.5, 0.9), max_attempts=50)
+    for _ in range(10):
+        img2, b2 = aug(img, boxes.copy())
+        assert (b2[:, 0] >= -1).all()
+        if b2[0, 0] >= 0:  # box survived: coords valid and normalized
+            assert 0 <= b2[0, 1] < b2[0, 3] <= 1
+            assert 0 <= b2[0, 2] < b2[0, 4] <= 1
+
+
+def test_random_pad_shrinks_boxes():
+    np.random.seed(1)
+    img = np.full((50, 50, 3), 255, np.uint8)
+    boxes = _boxes([2, 0.0, 0.0, 1.0, 1.0])
+    aug = DetRandomPadAug(max_expand=2.0, p=1.0)
+    img2, b2 = aug(img, boxes)
+    assert img2.shape[0] >= 50 and img2.shape[1] >= 50
+    w = b2[0, 3] - b2[0, 1]
+    h = b2[0, 4] - b2[0, 2]
+    assert w <= 1.0 and h <= 1.0
+    # the box still frames the original (bright) pixels
+    ys, xs = np.nonzero(img2[:, :, 0] == 255)
+    np.testing.assert_allclose(
+        [xs.min() / img2.shape[1], ys.min() / img2.shape[0]],
+        [b2[0, 1], b2[0, 2]], atol=0.03)
+
+
+def _make_det_rec(tmp_path, n=12, size=64):
+    from mxnet_tpu.io import IRHeader, MXRecordIO, pack
+    rng = np.random.default_rng(0)
+    path = os.path.join(tmp_path, "det.rec")
+    rec = MXRecordIO(path, "w")
+    for i in range(n):
+        img = rng.integers(0, 60, (size, size, 3)).astype(np.uint8)
+        img[20:40, 10:30] = 230
+        boxes = np.asarray([[i % 3, 10 / size, 20 / size, 30 / size,
+                             40 / size]], np.float32)
+        ok, buf = cv2.imencode(".jpg", img)
+        rec.write(pack(IRHeader(boxes.size, boxes.reshape(-1), i, 0),
+                       bytes(buf.tobytes())))
+    rec.close()
+    return path
+
+
+def test_image_det_iter_end_to_end(tmp_path):
+    path = _make_det_rec(str(tmp_path))
+    it = ImageDetIter(path, batch_size=4, data_shape=(3, 32, 32),
+                      max_objs=3, shuffle=True, to_device=False,
+                      det_aug_list=CreateDetAugmenter(
+                          (3, 32, 32), rand_mirror=True, brightness=0.1))
+    n = 0
+    for data, label in it:
+        assert data.shape == (4, 3, 32, 32)
+        assert label.shape == (4, 3, 5)
+        # exactly one real box per sample, pads are -1
+        assert ((label[:, 0, 0] >= 0) & (label[:, 0, 0] <= 2)).all()
+        assert (label[:, 1:, 0] == -1).all()
+        # normalized, ordered coords
+        valid = label[:, 0]
+        assert (valid[:, 1] < valid[:, 3]).all()
+        assert (valid[:, 2] < valid[:, 4]).all()
+        assert valid[:, 1:].min() >= 0 and valid[:, 1:].max() <= 1
+        n += data.shape[0]
+    assert n == 12
+
+    # labels feed multibox_target directly
+    anchors = mx.nd.multibox_prior(
+        mx.nd.array(np.zeros((1, 8, 8, 8))), sizes=(0.5, 0.7),
+        ratios=(1.0, 2.0))
+    bt, bm, ct = mx.nd.multibox_target(
+        anchors, mx.nd.array(label),
+        mx.nd.array(np.zeros((4, 4, anchors.shape[1]))))
+    assert ct.shape == (4, anchors.shape[1])
+
+
+def test_det_iter_rejects_classification_augs(tmp_path):
+    path = _make_det_rec(str(tmp_path), n=4)
+    with pytest.raises(MXNetError):
+        ImageDetIter(path, batch_size=2, data_shape=(3, 32, 32),
+                     aug_list=[lambda x: x])

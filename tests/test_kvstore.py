@@ -98,6 +98,7 @@ _WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow
 def test_dist_sync_two_process(tmp_path):
     """mx.kv.create('dist_sync') in a 2-process CPU rig via the launcher."""
     worker = tmp_path / "worker.py"

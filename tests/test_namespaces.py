@@ -66,11 +66,15 @@ def test_functional_higher_order():
     x = mx.nd.array([1.0, 2.0])
     np.testing.assert_allclose(g(x).asnumpy(), [3.0, 12.0], rtol=1e-6)
     np.testing.assert_allclose(h(x).asnumpy(), [6.0, 12.0], rtol=1e-6)
-    # autograd.grad(create_graph=True) points here and must keep raising
+    # the tape route (autograd.grad(create_graph=True)) now works too and
+    # must agree with the functional composition (tests/test_higher_order)
+    x.attach_grad()
     with mx.autograd.record():
-        y = (x * x).sum()
-    with pytest.raises(MXNetError, match="functional"):
-        mx.autograd.grad(y, x, create_graph=True)
+        y = (x ** 3).sum()
+        g1 = mx.autograd.grad(y, x, create_graph=True)
+        s1 = g1.sum()
+    g2 = mx.autograd.grad(s1, x)
+    np.testing.assert_allclose(g2.asnumpy(), [6.0, 12.0], rtol=1e-6)
 
 
 def test_functional_jit_vmap():

@@ -33,6 +33,7 @@ def test_forward_shapes():
     assert logits.shape == (2, 5, cfg.tgt_vocab_size)
 
 
+@pytest.mark.slow
 def test_overfit_copy_task_and_translate():
     """Sockeye-smoke: overfit 'copy the source' on a toy corpus, then the
     beam search must reproduce the training targets (BLEU-proxy = exact
@@ -77,6 +78,7 @@ def test_overfit_copy_task_and_translate():
     assert (np.diff(scores, axis=1) <= 1e-5).all()
 
 
+@pytest.mark.slow
 def test_beam_one_matches_stepwise_greedy():
     net, cfg = _tiny()
     rng = np.random.default_rng(4)

@@ -169,6 +169,7 @@ def test_fused_dropout_grads_match_materialized_mask():
                                    rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_fused_dropout_interpret_unbiased():
     q, k, v = _qkv(B=1, H=2)
     outs = jnp.stack([pa.fused_attention(q, k, v, dropout_p=0.3,

@@ -184,6 +184,7 @@ def test_all_to_all_tokens_roundtrip():
     np.testing.assert_array_equal(np.asarray(z), np.asarray(x))
 
 
+@pytest.mark.slow
 def test_moe_ffn_layer_trains():
     """MoEFFN gluon layer: forward shape, eager autograd, loss decreases
     under the fused TrainStep with ep sharding rules applied."""

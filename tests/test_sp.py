@@ -59,6 +59,7 @@ def test_ring_fully_masked_rows_zero():
     np.testing.assert_array_equal(np.asarray(out), 0.0)
 
 
+@pytest.mark.slow
 def test_ring_gradients_match():
     q, k, v = _qkv()
     mesh = par.make_mesh(sp=4, devices=jax.devices()[:4])
@@ -124,6 +125,7 @@ def test_ulysses_matches_single_device(causal):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_key_padding_mask_and_grads():
     q, k, v = _qkv()
     r = np.random.default_rng(2)
@@ -214,6 +216,7 @@ def test_auto_routes_to_sp_under_sp_mesh():
     assert _sp_auto_impl(q, k, None, train_drop=False) is None
 
 
+@pytest.mark.slow
 def test_trainstep_sp_end_to_end():
     """BERT TrainStep over a dp×sp mesh: impl='auto' puts a sequence-
     parallel collective (ulysses all-to-all here: heads divide by sp) in
@@ -268,6 +271,7 @@ def _train_bert_steps(mesh, rules, n_steps=3, seq_specs=False,
     return (losses, step) if return_step else losses
 
 
+@pytest.mark.slow
 def test_fsdp_matches_replicated():
     """ZeRO-style fsdp sharding must not change training numerics."""
     mesh_r = par.make_mesh(dp=2, fsdp=2, devices=jax.devices()[:4])

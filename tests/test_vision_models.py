@@ -81,6 +81,7 @@ def test_resnet_save_load_roundtrip(tmp_path):
     np.testing.assert_allclose(net2(x).asnumpy(), ref, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_resnet_trains_to_accuracy():
     """End-to-end convergence smoke (parity: tests/python/train/test_conv.py
     — MNIST to ~98% in seconds; here a synthetic separable 4-class problem

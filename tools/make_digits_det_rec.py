@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Build a detection dataset from REAL digit images (sklearn's 1,797
+handwritten digits — the real image data available under zero egress):
+each sample composites 1..--max-objs digits at random scales/positions
+onto a textured canvas; ground-truth boxes are the placement rectangles.
+This is the classic "digit detection" benchmark construction (the digit
+crops are real images; only the layout is synthesized — provenance
+documented in docs/RUNS.md).
+
+Output: im2rec-format RecordIO with vector labels
+[cls, x1, y1, x2, y2] * N (normalized), consumable by
+mxnet_tpu.image.ImageDetIter.
+
+Usage:
+    python tools/make_digits_det_rec.py --out /tmp/digits_det \
+        --size 256 --train 1600 --val 400
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def render_sample(rng, digits, labels, pool, size, max_objs):
+    canvas = rng.normal(30, 12, (size, size, 3)).clip(0, 80)
+    n = rng.integers(1, max_objs + 1)
+    boxes = []
+    occupied = []
+    import cv2
+    for _ in range(n):
+        for _attempt in range(20):
+            side = int(rng.uniform(0.15, 0.45) * size)
+            x0 = rng.integers(0, size - side)
+            y0 = rng.integers(0, size - side)
+            rect = (x0, y0, x0 + side, y0 + side)
+            if all(min(rect[2], r[2]) - max(rect[0], r[0]) <= 0
+                   or min(rect[3], r[3]) - max(rect[1], r[1]) <= 0
+                   for r in occupied):
+                break
+        else:
+            continue
+        j = pool[rng.integers(0, len(pool))]
+        glyph = (digits[j] / 16.0 * 255.0).astype(np.uint8)
+        glyph = cv2.resize(glyph, (side, side),
+                           interpolation=cv2.INTER_CUBIC).astype(np.float32)
+        # real digit strokes over the canvas (additive, zero background)
+        region = canvas[y0:y0 + side, x0:x0 + side]
+        canvas[y0:y0 + side, x0:x0 + side] = np.clip(
+            region + glyph[:, :, None], 0, 255)
+        occupied.append(rect)
+        boxes.append([float(labels[j]), x0 / size, y0 / size,
+                      (x0 + side) / size, (y0 + side) / size])
+    return canvas.astype(np.uint8), np.asarray(boxes, np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", required=True)
+    p.add_argument("--size", type=int, default=256)
+    p.add_argument("--train", type=int, default=1600)
+    p.add_argument("--val", type=int, default=400)
+    p.add_argument("--max-objs", type=int, default=4)
+    p.add_argument("--quality", type=int, default=95)
+    args = p.parse_args()
+
+    import cv2
+    from sklearn.datasets import load_digits
+    from mxnet_tpu.io import IRHeader, MXRecordIO, pack
+
+    d = load_digits()
+    rng = np.random.default_rng(0)
+    # digit-IMAGE split: val samples composite only held-out digit crops,
+    # so evaluation sees digit images never trained on
+    order = rng.permutation(len(d.target))
+    n_val_digits = len(order) // 5
+    pools = {"val": order[:n_val_digits], "train": order[n_val_digits:]}
+
+    os.makedirs(args.out, exist_ok=True)
+    for split, n_samples in (("train", args.train), ("val", args.val)):
+        path = os.path.join(args.out, f"{split}.rec")
+        rec = MXRecordIO(path, "w")
+        kept = 0
+        for i in range(n_samples):
+            img, boxes = render_sample(rng, d.images, d.target,
+                                       pools[split], args.size,
+                                       args.max_objs)
+            if not len(boxes):
+                continue
+            ok, buf = cv2.imencode(".jpg", img,
+                                   [cv2.IMWRITE_JPEG_QUALITY,
+                                    args.quality])
+            assert ok
+            rec.write(pack(IRHeader(boxes.size, boxes.reshape(-1), i, 0),
+                           bytes(buf.tobytes())))
+            kept += 1
+        rec.close()
+        print(f"{path}: {kept} composites at {args.size}px "
+              f"({len(pools[split])} distinct real digit crops)")
+
+
+if __name__ == "__main__":
+    main()

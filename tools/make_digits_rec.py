@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Pack the scikit-learn digits dataset (1,797 REAL 8x8 handwritten-digit
+images, shipped inside sklearn — the only real image dataset available in
+a zero-egress environment) into im2rec-format RecordIO files for the
+native input pipeline (tools/im2rec.py wire format; reference:
+tools/im2rec.py + src/io/iter_image_recordio_2.cc).
+
+Images are upscaled to --size (default 224, the ResNet-50 input shape)
+with cubic interpolation and JPEG-encoded, so the training path exercises
+the same decode/resize/augment pipeline an ImageNet recfile would.
+
+Usage:
+    python tools/make_digits_rec.py --out /tmp/digits --size 224
+Writes <out>/train.rec (1437 images) and <out>/val.rec (360 images),
+split deterministically (seed 0) and stratified by class.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", required=True)
+    p.add_argument("--size", type=int, default=224)
+    p.add_argument("--val-frac", type=float, default=0.2)
+    p.add_argument("--quality", type=int, default=95)
+    args = p.parse_args()
+
+    import cv2
+    from sklearn.datasets import load_digits
+    from mxnet_tpu.io import IRHeader, MXRecordIO, pack
+
+    d = load_digits()
+    images, labels = d.images, d.target  # (1797, 8, 8) float in [0, 16]
+    rng = np.random.default_rng(0)
+
+    # stratified split: last val_frac of a per-class shuffle -> val
+    val_mask = np.zeros(len(labels), bool)
+    for c in range(10):
+        idx = np.flatnonzero(labels == c)
+        idx = rng.permutation(idx)
+        n_val = int(round(len(idx) * args.val_frac))
+        val_mask[idx[:n_val]] = True
+
+    os.makedirs(args.out, exist_ok=True)
+    counts = {}
+    for split, mask in (("train", ~val_mask), ("val", val_mask)):
+        path = os.path.join(args.out, f"{split}.rec")
+        rec = MXRecordIO(path, "w")
+        ids = np.flatnonzero(mask)
+        if split == "train":
+            ids = rng.permutation(ids)
+        for i, j in enumerate(ids):
+            img8 = (images[j] / 16.0 * 255.0).astype(np.uint8)
+            img = cv2.resize(img8, (args.size, args.size),
+                             interpolation=cv2.INTER_CUBIC)
+            img = np.repeat(img[:, :, None], 3, axis=2)
+            ok, buf = cv2.imencode(
+                ".jpg", img, [cv2.IMWRITE_JPEG_QUALITY, args.quality])
+            assert ok
+            rec.write(pack(IRHeader(0, float(labels[j]), i, 0),
+                           bytes(buf.tobytes())))
+        rec.close()
+        counts[split] = len(ids)
+        print(f"{path}: {len(ids)} images at {args.size}x{args.size}")
+    return counts
+
+
+if __name__ == "__main__":
+    main()

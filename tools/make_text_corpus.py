@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Build a REAL-text MLM pretraining corpus from text available on the
+local machine (zero-egress environment: no downloads). Default sources:
+
+  * Python standard-library sources (/usr/lib/python3.*) — real code and
+    English docstrings/comments,
+  * installed-package sources (site-packages *.py, capped),
+  * /usr/share/doc plain-text documentation.
+
+Tokenization is BERT-style lowercased word/punctuation splitting with a
+frequency-built vocabulary (special tokens [PAD]=0 [UNK]=1 [CLS]=2
+[SEP]=3 [MASK]=4). Output: <out>/corpus.npz with int32 `train` / `val`
+token streams (split by document, 98/2) and <out>/vocab.json.
+
+Usage:
+    python tools/make_text_corpus.py --out /tmp/textcorpus --max-mb 48
+"""
+import argparse
+import collections
+import glob
+import json
+import os
+import re
+
+import numpy as np
+
+SPECIALS = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+TOKEN_RE = re.compile(r"[a-z0-9_]+|[^\sa-z0-9_]", re.IGNORECASE)
+
+
+def iter_files(max_bytes):
+    roots = []
+    for pat in ("/usr/lib/python3.*", ):
+        roots += sorted(glob.glob(pat))
+    site = sorted(glob.glob("/opt/venv/lib/python3.*/site-packages"))
+    doc_files = sorted(
+        glob.glob("/usr/share/doc/**/*.txt", recursive=True)
+        + glob.glob("/usr/share/doc/**/README*", recursive=True))[:500]
+    py_files = []
+    for r in roots:
+        py_files += sorted(glob.glob(os.path.join(r, "**", "*.py"),
+                                     recursive=True))
+    for r in site:
+        py_files += sorted(glob.glob(os.path.join(r, "**", "*.py"),
+                                     recursive=True))
+    total = 0
+    for path in py_files + doc_files:
+        try:
+            size = os.path.getsize(path)
+            if size > 2 * 1024 * 1024 or size < 256:
+                continue
+            with open(path, "rb") as f:
+                raw = f.read()
+            if b"\x00" in raw:
+                continue
+            text = raw.decode("utf-8", errors="ignore")
+        except OSError:
+            continue
+        yield path, text
+        total += len(text)
+        if total >= max_bytes:
+            return
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", required=True)
+    p.add_argument("--max-mb", type=float, default=48.0)
+    p.add_argument("--vocab-size", type=int, default=30522)
+    p.add_argument("--val-frac", type=float, default=0.02)
+    args = p.parse_args()
+
+    docs = []
+    counts = collections.Counter()
+    n_bytes = 0
+    for path, text in iter_files(int(args.max_mb * 1024 * 1024)):
+        toks = TOKEN_RE.findall(text.lower())
+        if len(toks) < 64:
+            continue
+        docs.append(toks)
+        counts.update(toks)
+        n_bytes += len(text)
+
+    vocab = {t: i for i, t in enumerate(SPECIALS)}
+    for tok, _ in counts.most_common(args.vocab_size - len(SPECIALS)):
+        vocab[tok] = len(vocab)
+    unk = vocab["[UNK]"]
+
+    rng = np.random.default_rng(0)
+    order = rng.permutation(len(docs))
+    n_val = max(1, int(len(docs) * args.val_frac))
+    val_ids, train_ids = set(order[:n_val].tolist()), None
+
+    def encode(doc_idx):
+        out = []
+        for i in doc_idx:
+            out.extend(vocab.get(t, unk) for t in docs[i])
+            out.append(vocab["[SEP]"])
+        return np.asarray(out, np.int32)
+
+    train = encode([i for i in range(len(docs)) if i not in val_ids])
+    val = encode(sorted(val_ids))
+
+    os.makedirs(args.out, exist_ok=True)
+    np.savez(os.path.join(args.out, "corpus.npz"), train=train, val=val)
+    with open(os.path.join(args.out, "vocab.json"), "w") as f:
+        json.dump(vocab, f)
+    oov = float(np.mean(train == unk))
+    print(f"{len(docs)} documents, {n_bytes/1e6:.1f} MB text, "
+          f"{len(train)/1e6:.2f}M train tokens / {len(val)/1e6:.2f}M val, "
+          f"vocab {len(vocab)}, train OOV rate {oov:.4f}")
+
+
+if __name__ == "__main__":
+    main()
