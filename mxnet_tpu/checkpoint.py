@@ -67,20 +67,40 @@ class TrainCheckpoint:
         else:
             key = jnp.zeros_like(jax.random.PRNGKey(0))
         scale = train_step._scale_state
+
+        def _g(a):
+            """Multi-process orbax refuses host-local arrays: lift small
+            replicated state to a GLOBAL fully-replicated array over the
+            step's mesh (params/opt states already carry global
+            NamedShardings). Single-process runs pass through."""
+            if jax.process_count() == 1 or train_step.mesh is None:
+                return a
+            from .parallel.mesh import PartitionSpec
+            sh = jax.sharding.NamedSharding(train_step.mesh,
+                                            PartitionSpec())
+            if getattr(a, "sharding", None) == sh:
+                return a
+            return jax.make_array_from_callback(
+                _np.shape(a), sh, lambda idx: _np.asarray(a)[idx])
+
         return {
             "params": list(train_step._param_arrays),
             "opt_states": [list(s) for s in train_step._opt_states],
-            "t": train_step._t,
-            "base_key": key,
+            "t": _g(train_step._t),
+            "base_key": _g(key),
             "has_key": _np.asarray(train_step._base_key is not None),
             "host_t": _np.asarray(train_step._host_t),
             # dynamic loss-scaler state rides along (placeholder + flag
             # when unused, so a no-AMP checkpoint can't poison a dynamic
             # run with scale 0)
-            "scale": (list(scale) if scale is not None
+            "scale": [_g(x) for x in (list(scale) if scale is not None
                       else [jnp.zeros((), jnp.float32),
-                            jnp.zeros((), jnp.int32)]),
+                            jnp.zeros((), jnp.int32)])],
             "has_scale": _np.asarray(scale is not None),
+            # compression error-feedback residuals (empty when off) —
+            # resume-exact requires them: they hold every sub-threshold
+            # gradient component not yet transmitted
+            "residuals": list(getattr(train_step, "_residuals", ())),
         }
 
     def save(self, step, train_step, data_cursor=None, wait=False):
@@ -134,7 +154,11 @@ class TrainCheckpoint:
             # ORIGINAL error if that is not the problem (a genuine
             # mismatch/corruption must not hide behind the retry)
             legacy = {k: v for k, v in template.items()
-                      if k not in ("scale", "has_scale")}
+                      if k not in ("scale", "has_scale", "residuals")}
+            if template.get("residuals"):
+                # a checkpoint without residuals cannot resume a
+                # compressed run exactly — surface the real error
+                raise first_err
             try:
                 restored = self._mgr.restore(
                     int(step),
@@ -155,6 +179,12 @@ class TrainCheckpoint:
                 jax.device_put(jnp.asarray(n), c.sharding)
                 for c, n in zip(cur_states, new_states)))
         train_step._opt_states = tuple(new_opt)
+        if state.get("residuals") is not None and \
+                getattr(train_step, "_residuals", ()):
+            train_step._residuals = tuple(
+                jax.device_put(jnp.asarray(n), c.sharding)
+                for c, n in zip(train_step._residuals,
+                                state["residuals"]))
         train_step._t = jnp.asarray(state["t"], jnp.int32)
         train_step._host_t = int(state["host_t"])
         train_step.optimizer.num_update = train_step._host_t

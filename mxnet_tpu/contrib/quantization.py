@@ -8,9 +8,10 @@ entropy) then runs int8 kernels through oneDNN/cuDNN; here the int8
 matmul is one lax.dot_general with int32 accumulation — which XLA:TPU
 executes natively — and calibration is a forward-hook pass.
 
-Scope (the reference's main path): symmetric per-tensor int8 for Dense
-layers via `quantize_net(net, calib_data)`; conv quantization follows
-the same recipe and is left to user code for now (documented)."""
+Scope: symmetric per-tensor int8 for Dense AND Conv2D layers via
+`quantize_net(net, calib_data, calib_mode=...)` with the reference's
+three calibration modes — 'minmax', 'entropy' (the calibrate.cc KL
+threshold search over a 2048-bin histogram), and 'percentile'."""
 from __future__ import annotations
 
 import jax
@@ -19,12 +20,13 @@ from jax import lax
 
 from ..base import MXNetError
 from ..gluon.block import HybridBlock
-from ..gluon.nn import Dense
+from ..gluon.nn import Conv2D, Dense
 from ..ndarray.ndarray import NDArray
 from ..ops.registry import apply_op, op
 
 __all__ = ["quantize_v2", "dequantize", "quantized_fully_connected",
-           "QuantizedDense", "quantize_net", "calib_ranges"]
+           "quantized_conv", "QuantizedDense", "QuantizedConv2D",
+           "quantize_net", "calib_ranges", "entropy_threshold"]
 
 
 @op("quantize_v2", nodiff=True)
@@ -80,6 +82,63 @@ def quantized_fully_connected(x_q, w_q, x_amax, w_amax, bias=None):
     return y
 
 
+@op("quantized_conv", nodiff=True)
+def quantized_conv(x_q, w_q, x_amax, w_amax, bias=None, stride=(1, 1),
+                   pad=(0, 0), dilate=(1, 1), num_group=1):
+    """int8 NCHW activations x int8 OIHW weights → f32, with int32 MXU
+    accumulation (parity: quantized_conv + requantize folded in)."""
+    dn = lax.conv_dimension_numbers(x_q.shape, w_q.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    acc = lax.conv_general_dilated(
+        x_q, w_q, window_strides=tuple(stride),
+        padding=[(p, p) for p in pad], rhs_dilation=tuple(dilate),
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    scale = (x_amax / 127.0) * (w_amax / 127.0)
+    y = acc.astype(jnp.float32) * scale
+    if bias is not None:
+        y = y + jnp.reshape(bias, (1, -1, 1, 1))
+    return y
+
+
+class QuantizedConv2D(HybridBlock):
+    """Conv2D replaced by int8 weight + calibrated activation range."""
+
+    def __init__(self, conv: Conv2D, act_amax, **kwargs):
+        super().__init__(**kwargs)
+        w = conv.weight.data()._data
+        amax_w = float(jnp.max(jnp.abs(w)))
+        self._w_q = _quantize_sym(w, amax_w)
+        self._w_amax = amax_w
+        self._act_amax = float(act_amax)
+        self._bias = (conv.bias.data()._data.astype(jnp.float32)
+                      if conv.bias is not None else None)
+        self._stride = conv._strides
+        self._pad = conv._padding
+        self._dilate = conv._dilation
+        self._groups = conv._groups
+        self._activation = conv._activation
+
+    def forward(self, x):
+        w_q, b = self._w_q, self._bias
+        act_amax, w_amax = self._act_amax, self._w_amax
+        stride, pad, dilate, groups = (self._stride, self._pad,
+                                       self._dilate, self._groups)
+        activation = self._activation
+
+        def closed(xd):
+            x_q = _quantize_sym(xd, act_amax)
+            y = quantized_conv.raw_fn(x_q, w_q, act_amax, w_amax, bias=b,
+                                      stride=stride, pad=pad,
+                                      dilate=dilate, num_group=groups)
+            if activation is not None:
+                from ..ops.nn import _act
+                y = _act(y, activation)
+            return y
+
+        return apply_op("QuantizedConv2D", closed, [x], nodiff=True)
+
+
 class QuantizedDense(HybridBlock):
     """Dense replaced by int8 weight + calibrated activation range."""
 
@@ -114,29 +173,107 @@ class QuantizedDense(HybridBlock):
         return apply_op("QuantizedDense", closed, [x], nodiff=True)
 
 
-def calib_ranges(net, calib_data, layers=None):
-    """Run calibration batches, recording per-Dense input |max| (parity:
-    calibrate.cc minmax mode). Returns {block: amax}.
+_N_HIST_BINS = 2048
+_N_QUANT_LEVELS = 128
 
-    Hybridized nets calibrate EAGERLY: hooks must see concrete values,
-    so hybridization is suspended for the calibration pass and restored
-    after (inside a jit trace the hook input would be an abstract
-    tracer)."""
-    ranges = {}
+
+def entropy_threshold(hist, bin_width, n_quant=_N_QUANT_LEVELS):
+    """calibrate.cc / TensorRT KL threshold search: over candidate clip
+    points i in [n_quant, nbins], fold outliers into the edge bin (P),
+    re-quantize the first i bins into n_quant levels and expand back
+    over the nonzero support (Q), and return the clip value minimizing
+    KL(P || Q)."""
+    import numpy as _anp
+    hist = _anp.asarray(hist, _anp.float64)
+    nbins = len(hist)
+    best_i, best_kl = nbins, _anp.inf
+    for i in range(n_quant, nbins + 1):
+        ref = hist[:i]
+        p = ref.copy()
+        p[i - 1] += hist[i:].sum()
+        if p.sum() <= 0:
+            continue
+        level_of = (_anp.arange(i) * n_quant) // i   # non-overlapping
+        nzmask = ref > 0
+        sums = _anp.bincount(level_of, weights=ref, minlength=n_quant)
+        counts = _anp.bincount(level_of, weights=nzmask.astype(float),
+                               minlength=n_quant)
+        q = _anp.zeros(i)
+        q[nzmask] = (sums / _anp.maximum(counts, 1))[level_of[nzmask]]
+        if q.sum() <= 0:
+            continue
+
+        def _smooth(d, eps=1e-4):
+            # the reference's _smooth_distribution: move eps of mass
+            # onto the zero bins so KL stays finite
+            is_zero = d == 0
+            n_zero = int(is_zero.sum())
+            n_nonzero = d.size - n_zero
+            if n_nonzero == 0:
+                return None
+            eps1 = eps * n_zero / n_nonzero
+            out = d.astype(_anp.float64).copy()
+            out[is_zero] = eps
+            out[~is_zero] -= eps1
+            if (out < 0).any():
+                return None
+            return out
+
+        # smooth the COUNT histograms (reference does the same: counts
+        # are >= 1 in populated bins, so eps never drives them negative),
+        # then normalize for the KL
+        ps = _smooth(p)
+        qs = _smooth(q)
+        if ps is None or qs is None:
+            continue
+        pn = ps / ps.sum()
+        qn = qs / qs.sum()
+        kl = float((pn * _anp.log(pn / qn)).sum())
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return best_i * bin_width
+
+
+def _collect(net, calib_data, want_hist, layer_types, layers=None):
+    """One calibration sweep: per-layer running amax and (optionally)
+    a 2048-bin |x| histogram (bins rescale-by-merging when the running
+    max doubles, so one pass suffices)."""
+    import numpy as _anp
+    stats = {}
     handles = []
     hybrid_flags = []
+
+    def hook(blk, args, _out=None):
+        a = _anp.abs(_anp.asarray(args[0].asnumpy(), _anp.float32)
+                     ).reshape(-1)
+        amax = float(a.max()) if a.size else 0.0
+        st = stats.setdefault(
+            id(blk), {"amax": 0.0,
+                      "hist": _anp.zeros(_N_HIST_BINS) if want_hist
+                      else None,
+                      "range": 0.0})
+        st["amax"] = max(st["amax"], amax)
+        if want_hist:
+            if st["range"] == 0.0:
+                st["range"] = max(amax, 1e-8)
+            while amax > st["range"]:
+                # double the range: merge adjacent bins
+                h = st["hist"]
+                st["hist"] = _anp.concatenate(
+                    [h[0::2] + h[1::2],
+                     _anp.zeros(_N_HIST_BINS // 2)])
+                st["range"] *= 2.0
+            h, _ = _anp.histogram(a, bins=_N_HIST_BINS,
+                                  range=(0.0, st["range"]))
+            st["hist"] += h
 
     def walk(block):
         if hasattr(block, "_active") and block._active:
             hybrid_flags.append(block)
             block._active = False
         for child in block._children.values():
-            if isinstance(child, Dense) and (layers is None
-                                             or child in layers):
-                def hook(blk, args, _out=None, _b=None):
-                    a = args[0]
-                    amax = float(jnp.max(jnp.abs(a._data)))
-                    ranges[id(blk)] = max(ranges.get(id(blk), 0.0), amax)
+            if isinstance(child, layer_types) and (layers is None
+                                                   or child in layers):
                 handles.append(child.register_forward_pre_hook(hook))
             walk(child)
 
@@ -150,24 +287,67 @@ def calib_ranges(net, calib_data, layers=None):
             h.detach()
         for b in hybrid_flags:
             b._active = True
-    return ranges
+    return stats
 
 
-def quantize_net(net, calib_data, exclude=None):
-    """Post-training-quantize a net's Dense layers in place (parity:
-    contrib.quantization.quantize_net, minmax calibration). Returns net.
-    Layers in `exclude` (or with <2 dims of weight) stay float."""
+def calib_ranges(net, calib_data, layers=None, calib_mode="minmax",
+                 percentile=99.99, layer_types=None):
+    """Run calibration batches, recording per-layer input ranges
+    (parity: calibrate.cc). Returns {id(block): amax}.
+
+    calib_mode: 'minmax' (running |max|), 'entropy' (KL threshold
+    search over a 2048-bin histogram, the reference's default for
+    activations), 'percentile' (the given percentile of |x|, read off
+    the same histogram).
+
+    Hybridized nets calibrate EAGERLY: hooks must see concrete values,
+    so hybridization is suspended for the calibration pass and restored
+    after (inside a jit trace the hook input would be an abstract
+    tracer)."""
+    import numpy as _anp
+    if calib_mode not in ("minmax", "entropy", "percentile"):
+        raise MXNetError(f"unknown calib_mode {calib_mode!r}")
+    layer_types = layer_types or (Dense,)
+    stats = _collect(net, calib_data, calib_mode != "minmax",
+                     layer_types, layers)
+    out = {}
+    for key, st in stats.items():
+        if calib_mode == "minmax" or st["hist"] is None \
+                or st["hist"].sum() == 0:
+            out[key] = st["amax"]
+        elif calib_mode == "entropy":
+            out[key] = entropy_threshold(
+                st["hist"], st["range"] / _N_HIST_BINS)
+        else:
+            h = st["hist"]
+            cdf = _anp.cumsum(h) / h.sum()
+            idx = int(_anp.searchsorted(cdf, percentile / 100.0))
+            out[key] = (idx + 1) * st["range"] / _N_HIST_BINS
+    return out
+
+
+def quantize_net(net, calib_data, exclude=None, calib_mode="minmax",
+                 percentile=99.99, quantize_conv=True):
+    """Post-training-quantize a net's Dense (and Conv2D) layers in place
+    (parity: contrib.quantization.quantize_net + calibrate.cc modes).
+    Returns net. Layers in `exclude` stay float."""
     exclude = set(id(b) for b in (exclude or []))
-    ranges = calib_ranges(net, calib_data)
+    types = (Dense, Conv2D) if quantize_conv else (Dense,)
+    ranges = calib_ranges(net, calib_data, calib_mode=calib_mode,
+                          percentile=percentile, layer_types=types)
 
     def walk(block):
         for name, child in list(block._children.items()):
-            if isinstance(child, Dense) and id(child) in ranges \
-                    and id(child) not in exclude:
-                setattr(block, name, QuantizedDense(child,
-                                                    ranges[id(child)]))
-            else:
-                walk(child)
+            if id(child) in ranges and id(child) not in exclude:
+                if isinstance(child, Dense):
+                    setattr(block, name,
+                            QuantizedDense(child, ranges[id(child)]))
+                    continue
+                if isinstance(child, Conv2D):
+                    setattr(block, name,
+                            QuantizedConv2D(child, ranges[id(child)]))
+                    continue
+            walk(child)
 
     walk(net)
 

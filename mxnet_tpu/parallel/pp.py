@@ -385,7 +385,7 @@ class PPTrainStep:
             lambda a: put(a, repl), head_params)
         mkstate = lambda tree, spec: jax.tree_util.tree_map(  # noqa: E731
             lambda a: tuple(jax.device_put(s_, spec)
-                            for s_ in optimizer.init_state_arrays(a)),
+                            for s_ in optimizer.init_state_arrays_mp(a)),
             tree)
         self._estate = mkstate(embed_params, repl)
         self._bstate = mkstate(stacked_params, pp_spec)
@@ -432,7 +432,7 @@ class PPTrainStep:
                 leaves_s = treedef.flatten_up_to(states)
                 new_p, new_s = [], []
                 for p_, g_, s_ in zip(leaves_p, leaves_g, leaves_s):
-                    np_, ns_ = opt.apply_arrays(p_, g_.astype(p_.dtype),
+                    np_, ns_ = opt.apply_arrays_mp(p_, g_,
                                                 tuple(s_), lr, wd, t)
                     new_p.append(np_)
                     new_s.append(ns_)

@@ -25,6 +25,7 @@ from contextlib import nullcontext as _nullcontext
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from .. import autograd, rng as _rng
 from ..base import MXNetError
@@ -57,14 +58,27 @@ class TrainStep:
 
     def __init__(self, net, loss_fn, optimizer, mesh=None, batch_specs=None,
                  donate=True, loss_reduce="mean", n_net_inputs=1,
-                 loss_scale=None, scale_window=2000):
+                 loss_scale=None, scale_window=2000, compression=None,
+                 compression_threshold=0.5):
         """loss_scale: None (bf16/f32 path), a float (static scaling), or
         'dynamic' — fp16-style dynamic loss scaling run ENTIRELY inside
         the compiled step: the loss is scaled before backward, gradients
         unscaled before the optimizer, non-finite gradients skip the
         update via jnp.where, and the scale halves on overflow / doubles
         after scale_window clean steps — zero host synchronization (the
-        reference's LossScaler pays a device→host check per step)."""
+        reference's LossScaler pays a device→host check per step).
+
+        compression='2bit': gradient reduction over the "dp" axis runs
+        through the reference's 2-bit wire (quantize → all_gather of
+        packed uint32 at 1/16 the f32 bytes → dequantize+sum) INSIDE the
+        compiled step, with per-device error-feedback residuals in the
+        step carry (donated like optimizer state) — the in-program
+        successor of src/kvstore/gradient_compression.cc
+        (parallel/compression.py; SURVEY §5.8 EQuARX analog). Requires a
+        mesh whose only model sharding is dp replication (pure data
+        parallelism) and makes BatchNorm statistics per-device (pmean'd
+        into the carried moving stats — the reference's dist-kvstore BN
+        behaves the same way)."""
         self.net = net
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -78,6 +92,25 @@ class TrainStep:
                               if loss_scale not in (None, "dynamic")
                               else None)
         self._scale_window = int(scale_window)
+        if compression not in (None, "2bit"):
+            raise MXNetError(f"unknown compression {compression!r}")
+        self._compression = compression
+        self._compression_threshold = float(compression_threshold)
+        if compression is not None:
+            if self.mesh is None or "dp" not in self.mesh.axis_names \
+                    or self.mesh.shape["dp"] < 2:
+                raise MXNetError(
+                    "compression='2bit' needs a mesh with a dp axis of "
+                    "size >= 2 (it compresses the dp gradient exchange)")
+            if any(ax != "dp" and n > 1
+                   for ax, n in self.mesh.shape.items()):
+                raise MXNetError(
+                    "compression='2bit' supports pure data parallelism "
+                    "(params replicated); drop tp/sp/pp/fsdp axes")
+            if loss_reduce != "mean":
+                raise MXNetError(
+                    "compression='2bit' requires loss_reduce='mean' "
+                    "(the compressed collective mean-reduces over dp)")
         if not optimizer.fused_supported:
             raise MXNetError(
                 f"{type(optimizer).__name__} has no functional path for the "
@@ -102,9 +135,20 @@ class TrainStep:
         self._param_arrays = [jnp.copy(p.data()._data)
                               for p in self._params]
         self._opt_states = tuple(
-            optimizer.init_state_arrays(a) if tr else ()
+            optimizer.init_state_arrays_mp(a) if tr else ()
             for a, tr in zip(self._param_arrays, self._trainable))
         self._t = jnp.zeros((), jnp.int32)
+        # per-device error-feedback residuals (leading dp axis, sharded)
+        self._residuals = ()
+        if self._compression is not None:
+            n_dp = self.mesh.shape["dp"]
+            with mesh_scope(self.mesh):
+                rspec = named_sharding(PartitionSpec("dp"))
+                self._residuals = tuple(
+                    jax.device_put(
+                        jnp.zeros((n_dp,) + a.shape, jnp.float32), rspec)
+                    for a, tr in zip(self._param_arrays, self._trainable)
+                    if tr)
         # dynamic loss-scaler state lives ON DEVICE in the step carry
         self._scale_state = (jnp.asarray(2.0 ** 16, jnp.float32),
                              jnp.zeros((), jnp.int32)) \
@@ -141,11 +185,11 @@ class TrainStep:
         return [_spec_or_replicated(p.sharding) for p in self._params]
 
     # -- build -------------------------------------------------------------
-    def _make_core(self):
+    def _make_core(self, n_batch):
         """The one-training-step function shared by the per-call program
         and the device-chained multi-step program:
-        core(tr, opt, t, scale_state, nt, key, lr, wd, batch) ->
-        (new_tr, new_opt, t, new_scale, loss, aux)."""
+        core(tr, opt, t, scale_state, nt, resid, key, lr, wd, batch) ->
+        (new_tr, new_opt, t, new_scale, new_resid, loss, aux)."""
         net, loss_fn, opt = self.net, self.loss_fn, self.optimizer
         params = self._params
         trainable = self._trainable
@@ -202,13 +246,19 @@ class TrainStep:
 
         self._nt_pos, self._tr_pos = nt_pos, tr_pos
 
-        def core(tr_datas, opt_states, t, scale_state, nt_datas,
+        compression = self._compression
+        comp_thr = self._compression_threshold
+
+        def core(tr_datas, opt_states, t, scale_state, nt_datas, resid,
                  base_key, lr, wd, batch_datas):
             t = t + 1
             # per-step randomness derived INSIDE the program (no host RNG
             # round-trip per step; the reference's engine-managed Philox
             # streams achieve the same "no host in the loop" property)
             key = jax.random.fold_in(base_key, t)
+            if compression is not None:
+                # per-device dropout streams under the dp shard_map
+                key = jax.random.fold_in(key, lax.axis_index("dp"))
             if dynamic:
                 scale, good = scale_state
             elif static_scale is not None:
@@ -236,11 +286,38 @@ class TrainStep:
                 grads = tuple(
                     (g.astype(jnp.float32) * inv).astype(g.dtype)
                     for g in grads)
+            ok = None
             if dynamic:
+                # overflow detection runs on the RAW local grads: after
+                # 2-bit quantization NaN/Inf would vanish (they compare
+                # False against both thresholds → code 0) and the
+                # overflow would both apply and poison the residual
                 ok = jnp.asarray(True)
                 for g in grads:
-                    ok = ok & jnp.isfinite(
-                        g.astype(jnp.float32)).all()
+                    ok = ok & jnp.isfinite(g.astype(jnp.float32)).all()
+                if compression is not None:
+                    ok = lax.pmin(ok.astype(jnp.int32), "dp") > 0
+            if compression is not None:
+                # the dp gradient exchange through the 2-bit wire; the
+                # reduced grads come back identical on every device
+                from .compression import compressed_psum_mean
+                red, new_resid = [], []
+                for g, r in zip(grads, resid):
+                    rg, nr = compressed_psum_mean(g, r[0], "dp",
+                                                  comp_thr)
+                    if ok is not None:  # overflow: residual keeps its
+                        nr = jnp.where(ok, nr, r[0])  # pre-step value
+                    red.append(rg.astype(g.dtype))
+                    new_resid.append(nr[None])
+                grads = tuple(red)
+                new_resid = tuple(new_resid)
+                loss = lax.pmean(loss, "dp")
+                aux = tuple(lax.pmean(a.astype(jnp.float32), "dp")
+                            .astype(a.dtype) for a in aux)
+            else:
+                new_resid = resid
+            if dynamic:
+                # `ok` was computed from the RAW grads above
                 # an overflow step must not poison mutable layer state
                 # either (BN running stats from the same corrupted
                 # forward): keep each stat's incoming value
@@ -266,7 +343,7 @@ class TrainStep:
                 g = next(git)
                 plr = lr * mlr if mlr != 1.0 else lr
                 pwd = wd * mwd if mwd != 1.0 else wd
-                nw, ns = opt.apply_arrays(d, g, st, plr, pwd, t)
+                nw, ns = opt.apply_arrays_mp(d, g, st, plr, pwd, t)
                 if dynamic:
                     # overflow: keep the old weights/states (skip update)
                     nw = jnp.where(ok, nw, d)
@@ -291,9 +368,32 @@ class TrainStep:
             else:
                 new_scale_state = scale_state
             return (tuple(new_params), tuple(new_states), t,
-                    new_scale_state, loss, aux)
+                    new_scale_state, new_resid, loss, aux)
 
-        return core
+        if compression is None:
+            return core
+        # compressed path: the whole step runs SPMD inside a shard_map
+        # over "dp" — params/states replicated (P() prefix specs), batch
+        # and residuals sharded — so the dp gradient exchange is OUR
+        # 2-bit collective, not XLA's f32 psum
+        from .mesh import shard_map_compat
+        repl = PartitionSpec()
+        dp = PartitionSpec("dp")
+        bspecs = tuple(self.batch_specs or [dp] * n_batch)
+
+        def global_core(tr_datas, opt_states, t, scale_state, nt_datas,
+                        resid, base_key, lr, wd, batch_datas):
+            wrapped = shard_map_compat(
+                core, mesh=self.mesh,
+                in_specs=(repl, repl, repl, repl, repl, dp, repl, repl,
+                          repl, bspecs),
+                out_specs=(repl, repl, repl, repl, dp, repl, repl),
+                check_rep=False)
+            return wrapped(tr_datas, opt_states, t, scale_state,
+                           nt_datas, resid, base_key, lr, wd,
+                           batch_datas)
+
+        return global_core
 
     def _jit_shardings(self, n_batch, stacked=False):
         """(in_shardings tuple, or None when no mesh) for the step args
@@ -323,18 +423,20 @@ class TrainStep:
             sscale = jax.tree_util.tree_map(
                 lambda _: repl, self._scale_state) \
                 if self._scale_state is not None else ()
+            rspecs = tuple(named_sharding(PartitionSpec("dp"))
+                           for _ in self._residuals)
             return (tr_pspecs, sspecs, repl, sscale,
-                    nt_pspecs, repl, repl, repl) + bspecs
+                    nt_pspecs, rspecs, repl, repl, repl) + bspecs
 
     def _build(self, n_batch):
-        core = self._make_core()
+        core = self._make_core(n_batch)
 
         def step_fn(tr_datas, opt_states, t, scale_state, nt_datas,
-                    base_key, lr, wd, *batch_datas):
+                    resid, base_key, lr, wd, *batch_datas):
             return core(tr_datas, opt_states, t, scale_state, nt_datas,
-                        base_key, lr, wd, batch_datas)
+                        resid, base_key, lr, wd, batch_datas)
 
-        donate = (0, 1, 2) if self.donate else ()
+        donate = (0, 1, 2, 5) if self.donate else ()
         shardings = self._jit_shardings(n_batch)
         if shardings is not None:
             with mesh_scope(self.mesh):
@@ -358,7 +460,7 @@ class TrainStep:
         carry, so K chained steps accumulate stats exactly like K
         single-step calls. lr/wd are captured once per dispatch —
         host-side schedulers take effect between run_steps() calls."""
-        core = self._make_core()
+        core = self._make_core(n_batch)
         trainable = self._trainable
         params = self._params
         meta = self._meta
@@ -366,12 +468,12 @@ class TrainStep:
         n_rep = repeat_steps
 
         def multi_fn(tr_datas, opt_states, t, scale_state, nt_datas,
-                     base_key, lr, wd, *stacked):
+                     resid, base_key, lr, wd, *stacked):
             def body(carry, xs):
-                tr_c, opt_c, t_c, scale_c, nt_c = carry
-                (tr_n, opt_n, t_n, scale_n, loss, aux) = core(
-                    tr_c, opt_c, t_c, scale_c, nt_c, base_key, lr, wd,
-                    stacked if n_rep else xs)
+                tr_c, opt_c, t_c, scale_c, nt_c, rs_c = carry
+                (tr_n, opt_n, t_n, scale_n, rs_n, loss, aux) = core(
+                    tr_c, opt_c, t_c, scale_c, nt_c, rs_c, base_key, lr,
+                    wd, stacked if n_rep else xs)
                 if aux:
                     # thread state updates (BN stats) into the carry the
                     # same way __call__ threads them into _param_arrays:
@@ -390,18 +492,19 @@ class TrainStep:
                     nt_n, tr_n = tuple(nt_n), tuple(tr_n)
                 else:
                     nt_n = nt_c
-                return (tr_n, opt_n, t_n, scale_n, nt_n), loss
+                return (tr_n, opt_n, t_n, scale_n, nt_n, rs_n), loss
 
-            init = (tr_datas, opt_states, t, scale_state, nt_datas)
-            (tr_f, opt_f, t_f, scale_f, nt_f), losses = jax.lax.scan(
-                body, init, None if n_rep else stacked,
-                length=n_rep if n_rep else None)
-            return tr_f, opt_f, t_f, scale_f, nt_f, losses
+            init = (tr_datas, opt_states, t, scale_state, nt_datas,
+                    resid)
+            (tr_f, opt_f, t_f, scale_f, nt_f, rs_f), losses = \
+                jax.lax.scan(body, init, None if n_rep else stacked,
+                             length=n_rep if n_rep else None)
+            return tr_f, opt_f, t_f, scale_f, nt_f, rs_f, losses
 
         # nt is NOT donated even here: its input buffers may be the very
         # arrays the Parameters hold (after a prior stat write-back), and
         # they are tiny
-        donate = (0, 1, 2) if self.donate else ()
+        donate = (0, 1, 2, 5) if self.donate else ()
         shardings = self._jit_shardings(n_batch,
                                         stacked=repeat_steps is None)
         if shardings is not None:
@@ -432,9 +535,10 @@ class TrainStep:
          wd) = self._prepare_dispatch(entry, datas)
         with _mesh_ctx(self.mesh):
             out = entry["jitted"](tr_arrays, tr_states, self._t,
-                                  scale_state, nt_arrays, key, lr, wd,
-                                  *datas)
-        (new_tr_arrays, new_tr_states, self._t, new_scale, loss, aux) = out
+                                  scale_state, nt_arrays,
+                                  self._residuals, key, lr, wd, *datas)
+        (new_tr_arrays, new_tr_states, self._t, new_scale,
+         self._residuals, loss, aux) = out
         self._write_back(new_tr_arrays, new_tr_states)
         if self._scale_state is not None:
             self._scale_state = new_scale
@@ -490,7 +594,7 @@ class TrainStep:
             entry["lower_args"] = jax.tree_util.tree_map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                 (tr_arrays, tr_states, self._t, scale_state, nt_arrays,
-                 key, lr, wd) + datas)
+                 self._residuals, key, lr, wd) + datas)
         return tr_arrays, tr_states, scale_state, nt_arrays, key, lr, wd
 
     def _write_back(self, new_tr, new_states):
@@ -558,9 +662,10 @@ class TrainStep:
          wd) = self._prepare_dispatch(entry, datas)
         with _mesh_ctx(self.mesh):
             out = entry["jitted"](tr_arrays, tr_states, self._t,
-                                  scale_state, nt_arrays, key, lr, wd,
-                                  *datas)
-        (new_tr, new_states, self._t, new_scale, new_nt, losses) = out
+                                  scale_state, nt_arrays,
+                                  self._residuals, key, lr, wd, *datas)
+        (new_tr, new_states, self._t, new_scale, new_nt,
+         self._residuals, losses) = out
         self._write_back(new_tr, new_states)
         it_n = iter(new_nt)
         for i, tr in enumerate(self._trainable):

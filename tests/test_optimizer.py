@@ -3,6 +3,7 @@
 Modeled on tests/python/unittest/test_optimizer.py + test_gluon_trainer.py:
 each rule validated against a NumPy reference implementation.
 """
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -67,9 +68,12 @@ def test_adamw_decoupled_decay():
     o = opt.AdamW(learning_rate=0.1, wd=0.1)
     state = o.create_state(0, weight)
     o.update(0, weight, grad, state)
-    # zero grad: update is pure decoupled decay w -= eta*wd*w (paper/MXNet
-    # convention: wd is NOT scaled by lr, only by the eta multiplier)
-    np.testing.assert_allclose(weight.asnumpy(), w0 * (1 - 0.1), rtol=1e-5)
+    # zero grad: update is pure decoupled decay w -= eta*lr*wd*w (the
+    # lr-scaled Loshchilov-Hutter form every practical AdamW uses —
+    # PyTorch, optax; the unscaled form shrinks 1%/step at wd=0.01 and
+    # collapses long pretraining runs)
+    np.testing.assert_allclose(weight.asnumpy(), w0 * (1 - 0.1 * 0.1),
+                               rtol=1e-5)
 
 
 def test_lamb_trust_ratio_changes_step():
@@ -230,3 +234,23 @@ def test_perplexity_ignore_label():
     ppl = mmetric.Perplexity(ignore_label=1)
     ppl.update(l, p)
     assert ppl.get()[1] == pytest.approx(2.0, rel=1e-5)
+
+
+def test_adamw_decay_is_lr_scaled():
+    """AdamW's decoupled decay must shrink weights by lr*wd per step,
+    not wd per step (regression: the unscaled form collapsed BERT MLM
+    pretraining — 1%/step at wd=0.01 drives weights to zero)."""
+    from mxnet_tpu import optimizer as opt
+
+    o = opt.AdamW(learning_rate=1e-3, wd=0.1)
+    w = jnp.full((4,), 2.0, jnp.float32)
+    st = o.init_state_arrays_mp(w)
+    g = jnp.zeros((4,), jnp.float32)
+    lr = jnp.asarray(1e-3, jnp.float32)
+    wd = jnp.asarray(0.1, jnp.float32)
+    for t in range(1, 11):
+        w, st = o.apply_arrays_mp(w, g, st, lr, wd,
+                                  jnp.asarray(t, jnp.int32))
+    # 10 steps of zero-grad AdamW: w *= (1 - lr*wd)^10
+    want = 2.0 * (1 - 1e-3 * 0.1) ** 10
+    np.testing.assert_allclose(np.asarray(w), want, rtol=1e-5)
