@@ -53,8 +53,9 @@ def main():
 
     data_dir = args.data
     if not data_dir:
-        data_dir = os.path.join(tempfile.gettempdir(),
-                                f"digits_det_{args.size}")
+        data_dir = os.path.join(
+            tempfile.gettempdir(),
+            f"digits_det_{args.size}_{args.train}_{args.val}")
         if not os.path.exists(os.path.join(data_dir, "train.rec")):
             sys.path.insert(0, os.path.join(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__))), "tools"))
@@ -116,9 +117,15 @@ def main():
         step.sync_params()
         metric = VOCMApMetric(iou_thresh=0.5,
                               class_names=[str(i) for i in range(10)])
+        n_eval = 0
         for data, label in val_it:
             out = net.detect(norm(data), threshold=0.05)  # (B, N, 6)
             metric.update(label, out)
+            n_eval += data.shape[0]
+        if n_eval == 0:
+            raise RuntimeError(
+                "validation iterator yielded no batches (batch size "
+                "larger than the val set? partial batches are dropped)")
         names, vals = metric.get()
         return vals[-1] if isinstance(vals, list) else vals
 

@@ -46,6 +46,29 @@ int64 = "int64"
 uint8 = "uint8"
 bool_ = "bool"
 
+class _SubNamespace:
+    """Lift a jnp submodule (linalg, fft) function-by-function through
+    the op funnel, so mx.np.linalg.inv etc. take/return NDArrays and
+    tape (parity: python/mxnet/numpy/linalg.py)."""
+
+    def __init__(self, jmod, prefix):
+        self._jmod = jmod
+        self._prefix = prefix
+        self._cache = {}
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._cache:
+            jfn = getattr(self._jmod, name)  # AttributeError propagates
+            self._cache[name] = _op(
+                name=f"np_{self._prefix}_{name}", register=False)(jfn)
+        return self._cache[name]
+
+
+linalg = _SubNamespace(_jnp.linalg, "linalg")
+fft = _SubNamespace(_jnp.fft, "fft")
+
 _cache = {}
 
 

@@ -111,6 +111,8 @@ def where(cond, a, b):
 
 @op("add_n")
 def add_n(*args):
+    if len(args) == 1 and isinstance(args[0], (tuple, list)):
+        args = tuple(args[0])
     out = args[0]
     for a in args[1:]:
         out = out + a

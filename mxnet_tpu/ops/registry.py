@@ -127,7 +127,46 @@ def op(name=None, nodiff=False, register=True):
                 NDArray_holder["c"] = NDArray
             out = kwargs.pop("out", None)
             nd_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+            # one level of sequence args (stack/concatenate style) also
+            # tapes: np.stack([a, b]) must contribute tape nodes, not
+            # silently skip autograd
+            seq_pos = [i for i, a in enumerate(args)
+                       if isinstance(a, (list, tuple)) and a
+                       and any(isinstance(e, NDArray) for e in a)]
             nd_keys = [k for k, v in kwargs.items() if isinstance(v, NDArray)]
+            if seq_pos:
+                seq_meta = []
+                for i in seq_pos:
+                    epos = [j for j, e in enumerate(args[i])
+                            if isinstance(e, NDArray)]
+                    seq_meta.append((i, tuple(epos)))
+                seq_arrs = [e for i in seq_pos for e in args[i]
+                            if isinstance(e, NDArray)]
+                arrs = [args[i] for i in nd_pos] + seq_arrs + \
+                    [kwargs[k] for k in nd_keys]
+                n_pos = len(nd_pos)
+                n_seq = len(seq_arrs)
+
+                def closed(*datas, _sargs=args, _kw=kwargs,
+                           _pos=tuple(nd_pos), _keys=tuple(nd_keys),
+                           _meta=tuple(seq_meta), _n=n_pos, _ns=n_seq):
+                    full = list(_sargs)
+                    for i, d in zip(_pos, datas[:_n]):
+                        full[i] = d
+                    it = iter(datas[_n:_n + _ns])
+                    for i, epos in _meta:
+                        elems = list(_sargs[i])
+                        for j in epos:
+                            elems[j] = next(it)
+                        full[i] = type(_sargs[i])(elems) \
+                            if isinstance(_sargs[i], tuple) else elems
+                    kw = dict(_kw)
+                    for k, d in zip(_keys, datas[_n + _ns:]):
+                        kw[k] = d
+                    return fn(*full, **kw)
+
+                return apply_op(name, closed, arrs, out=out,
+                                nodiff=nodiff)
             arrs = [args[i] for i in nd_pos] + [kwargs[k] for k in nd_keys]
             if not arrs:
                 # creation-style op: run directly (no tape without tensor in)

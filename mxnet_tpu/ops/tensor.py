@@ -112,6 +112,8 @@ def broadcast_axis(x, axis=None, size=None):
 
 @op("concat")
 def concat(*args, dim=1, axis=None):
+    if len(args) == 1 and isinstance(args[0], (tuple, list)):
+        args = tuple(args[0])
     return jnp.concatenate(args, axis=dim if axis is None else axis)
 
 
@@ -127,6 +129,8 @@ def concatenate(*args, axis=0):
 
 @op("stack")
 def stack(*args, axis=0):
+    if len(args) == 1 and isinstance(args[0], (tuple, list)):
+        args = tuple(args[0])
     return jnp.stack(args, axis=axis)
 
 

@@ -12,6 +12,8 @@ from .rules import (  # noqa: F401
     ShardingRules, apply_sharding_rules, ep_rules, fsdp_rules,
     megatron_dense_rules)
 from .sp import ring_attention, sp_enabled, ulysses_attention  # noqa: F401
+from .comm import (collective_summary, comm_report,  # noqa: F401
+                   ring_cost_bytes)
 from .pp import (PPTrainStep, gpipe, pipeline_grads,  # noqa: F401
                  pipeline_loss, stack_stage_params)
 from .moe import (  # noqa: F401

@@ -197,3 +197,30 @@ def test_trainstep_honors_wd_mult():
     np.testing.assert_allclose(net.weight.data().asnumpy(),
                                w0 - 0.1 * (g_w + 0.5 * w0), rtol=1e-4,
                                atol=1e-5)
+
+
+def test_comm_report_prices_dp_collectives():
+    """parallel.comm_report reads the collectives out of a compiled step
+    and prices them with the ring model (VERDICT r4 weak #9)."""
+    mesh = par.make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    net = nn.Dense(16, in_units=32)
+    mx.rng.seed(0)
+    net.initialize(mx.init.Xavier())
+    step = par.TrainStep(net, gloss.L2Loss(), opt.SGD(learning_rate=0.1),
+                         mesh=mesh)
+    r = np.random.default_rng(0)
+    x = mx.nd.array(r.standard_normal((8, 32)), dtype="float32")
+    y = mx.nd.array(r.standard_normal((8, 16)), dtype="float32")
+    float(step(x, y).asscalar())
+    report = par.comm_report(step)
+    assert "all_reduce" in report, report
+    assert "total wire time" in report
+    rows = par.collective_summary(
+        step._lowered().compile().as_text())
+    assert any(row["kind"] == "all_reduce" and row["bytes"] > 0
+               for row in rows), rows
+    # the ring model itself
+    assert par.ring_cost_bytes("all_reduce", 1000, 4) == 1500
+    assert par.ring_cost_bytes("all_gather", 1000, 4) == 750
+    assert par.ring_cost_bytes("collective_permute", 1000, 4) == 1000
+    assert par.ring_cost_bytes("all_reduce", 1000, 1) == 0

@@ -24,7 +24,12 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def latest_bench():
     """Newest bench records keyed by metric."""
     recs = {}
-    driver = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
+    def _round_no(p):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
+    driver = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")),
+                    key=_round_no)
     paths = list(driver)
     for extra in ("BENCH_GPT2.json", "BENCH_LONGCONTEXT.json",
                   "BENCH_BERT_LARGE.json", "BENCH_RESNET.json"):
