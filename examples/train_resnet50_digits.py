@@ -97,8 +97,17 @@ def main():
         os.path.join(data_dir, "train.rec"), batch_size=args.batch,
         data_shape=(3, args.size, args.size), shuffle=True,
         aug_list=[ShiftJitterAug()])
+    # the iterator drops partial batches (reference batching contract);
+    # evaluation must cover EVERY held-out image, so the val iterator
+    # uses one full-set batch
+    from mxnet_tpu.io import MXRecordIO
+    _vr = MXRecordIO(os.path.join(data_dir, "val.rec"), "r")
+    n_val = 0
+    while _vr.read() is not None:
+        n_val += 1
+    _vr.close()
     val_it = ImageRecordIter(
-        os.path.join(data_dir, "val.rec"), batch_size=args.batch,
+        os.path.join(data_dir, "val.rec"), batch_size=n_val,
         data_shape=(3, args.size, args.size), shuffle=False)
 
     net = get_model(args.model, classes=10)
