@@ -292,6 +292,108 @@ def bench_gpt2_decode():
     return 0
 
 
+def bench_gpt2_serving():
+    """GPT-2 continuous-batching serving throughput (serving/engine.py —
+    the ragged paged-attention decode path). Poisson request arrivals
+    with mixed prompt/output lengths; reports sustained tokens/sec plus
+    p50/p99 per-token latency (first-token latency counts from
+    submission; later tokens from the previous token, both at
+    decode-block resolution). No reference-side number exists (the
+    reference has no serving engine at all), so vs_baseline is 0.0."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import GPT2ForCausalLM, gpt2_774m_config
+    from mxnet_tpu.serving import Request, ServingEngine
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8))
+    block = int(os.environ.get("BENCH_SERVE_BLOCK", 8))
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS",
+                                    32 if on_tpu else 8))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", 0))  # req/s; 0=open
+    cfg = gpt2_774m_config(dtype="bfloat16" if on_tpu else "float32",
+                           dropout=0.0, attention_dropout=0.0)
+    max_len, page = 1024, 64
+    p_lo, p_hi, o_lo, o_hi = 16, 128, 32, 128
+    if not on_tpu:  # CPU smoke config
+        cfg.vocab_size, cfg.units, cfg.hidden_size = 512, 64, 256
+        cfg.num_layers, cfg.num_heads, cfg.max_length = 2, 2, 64
+        max_len, page = 64, 8
+        p_lo, p_hi, o_lo, o_hi = 2, 12, 4, 12
+        slots, block = min(slots, 4), min(block, 4)
+
+    net = GPT2ForCausalLM(cfg)
+    net.initialize(mx.init.Normal(0.02))
+    if on_tpu:
+        net.cast("bfloat16")
+    rng = np.random.default_rng(0)
+
+    def mk_requests(n, id0=0):
+        out = []
+        for i in range(n):
+            plen = int(rng.integers(p_lo, p_hi + 1))
+            out.append(Request(
+                rng.integers(0, cfg.vocab_size, plen).tolist(),
+                int(rng.integers(o_lo, o_hi + 1)),
+                do_sample=bool(i % 2), temperature=0.8, top_k=40,
+                seed=i, request_id=id0 + i))
+        return out
+
+    eng = ServingEngine(net, num_slots=slots, max_length=max_len,
+                        page_size=page, decode_block=block)
+    # warmup: compile the decode program + the prefill buckets the
+    # arrival mix will hit (every bucket in [p_lo, p_hi])
+    warm = [Request(list(range(1, b + 1)), 2, request_id=f"w{b}")
+            for b in range(page, max(p_hi + page, page + 1), page)]
+    eng.serve(warm)
+
+    reqs = mk_requests(n_requests, id0=1000)
+    gaps = rng.exponential(1.0 / rate, n_requests) if rate > 0 \
+        else np.zeros(n_requests)
+    arrivals = np.cumsum(gaps)
+    t0 = time.perf_counter()
+    pending = list(zip(arrivals, reqs))
+    while pending or eng.has_work:
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            eng.submit(pending.pop(0)[1])
+        if eng.has_work:
+            eng.step()
+        elif pending:
+            time.sleep(min(pending[0][0] - now, 0.01))
+    dt = time.perf_counter() - t0
+
+    total_tokens = sum(len(r.output_tokens) for r in reqs)
+    # per-token latency = each request's (finish - submit) / tokens; the
+    # p50/p99 spread across requests captures queueing + slot contention
+    tpot = np.asarray([(r.t_finish - r.t_submit)
+                       / max(len(r.output_tokens), 1) for r in reqs])
+    ttft = np.asarray([r.token_times[0] - r.t_submit for r in reqs])
+    toks_per_sec = total_tokens / dt
+    _emit("gpt2_serving_tokens_per_sec", round(toks_per_sec, 1),
+          "tokens/sec", 0.0, extras={
+              "requests": n_requests, "slots": slots,
+              "decode_block": block, "total_tokens": total_tokens,
+              "makespan_s": round(dt, 3),
+              "p50_token_latency_ms": round(
+                  float(np.percentile(tpot, 50)) * 1e3, 2),
+              "p99_token_latency_ms": round(
+                  float(np.percentile(tpot, 99)) * 1e3, 2),
+              "p50_first_token_ms": round(
+                  float(np.percentile(ttft, 50)) * 1e3, 2),
+              "prompt_lens": f"U[{p_lo},{p_hi}]",
+              "output_lens": f"U[{o_lo},{o_hi}]",
+              "arrivals": "open-loop" if rate == 0
+                          else f"poisson({rate}/s)",
+              "params": cfg.num_params(),
+              "device": str(dev.device_kind),
+              "kv_cache": f"ragged paged({page})",
+              "baseline": "none (reference has no serving path)",
+          })
+    return 0
+
+
 def bench_longcontext():
     """Long-context attention: fwd+bwd through the blockwise flash path
     at sequence lengths whose (T, T) score matrix would not fit
@@ -429,6 +531,8 @@ def main():
         return bench_resnet50()
     if workload in ("gpt2", "gpt2_decode", "gpt2_774m"):
         return bench_gpt2_decode()
+    if workload in ("serving", "gpt2_serving"):
+        return bench_gpt2_serving()
     if workload == "decode":
         return bench_decode()
     if workload in ("longcontext", "long"):

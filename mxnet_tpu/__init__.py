@@ -52,6 +52,7 @@ def __getattr__(name):
         "test_utils": "mxnet_tpu.test_utils",
         "runtime": "mxnet_tpu.runtime",
         "engine": "mxnet_tpu.engine",
+        "serving": "mxnet_tpu.serving",
         "context": "mxnet_tpu.device",
         "functional": "mxnet_tpu.functional",
         "models": "mxnet_tpu.models",

@@ -29,7 +29,9 @@ over StableHLO + params (see export()).
 """
 from __future__ import annotations
 
+import os
 import threading
+from collections import OrderedDict
 
 import numpy as _np
 import jax
@@ -74,6 +76,55 @@ _trace_channel = _TraceChannel()
 
 def is_tracing() -> bool:
     return _trace_channel.active
+
+
+# -- bounded trace caches ----------------------------------------------------
+# Bucketed/ragged shape churn (BucketingModule batches, serving prefill
+# buckets) retraces hybrid forwards per signature; without a bound the
+# per-block jit caches grow for the life of the process. Every trace cache
+# (HybridBlock._jit_cache, GPT2._generate_cache) is an LRU with a global
+# retrace/eviction counter surfaced through mx.runtime.jit_cache_stats().
+
+_jit_cache_stats = {"retraces": 0, "evictions": 0}
+
+
+def jit_cache_stats():
+    """Process-wide trace-cache counters: {'retraces': compiled-program
+    builds across all LRU trace caches, 'evictions': entries dropped by
+    the LRU bound}. A retrace rate that keeps climbing in steady state
+    means shape churn is defeating the caches (pad/bucket the inputs)."""
+    return dict(_jit_cache_stats)
+
+
+def reset_jit_cache_stats():
+    _jit_cache_stats["retraces"] = 0
+    _jit_cache_stats["evictions"] = 0
+
+
+class LRUTraceCache(OrderedDict):
+    """Bounded mapping signature → compiled entry, LRU eviction. maxsize
+    None/0 reads MXNET_TPU_JIT_CACHE_SIZE (default 64)."""
+
+    def __init__(self, maxsize=None):
+        super().__init__()
+        if not maxsize:
+            maxsize = int(os.environ.get("MXNET_TPU_JIT_CACHE_SIZE", 64))
+        self.maxsize = max(int(maxsize), 1)
+
+    def get(self, key, default=None):
+        if key not in self:
+            return default
+        self.move_to_end(key)
+        return super().__getitem__(key)
+
+    def __setitem__(self, key, value):
+        if key not in self:
+            _jit_cache_stats["retraces"] += 1
+        super().__setitem__(key, value)
+        self.move_to_end(key)
+        while len(self) > self.maxsize:
+            self.popitem(last=False)
+            _jit_cache_stats["evictions"] += 1
 
 
 def push_state_update(param, new_data):
@@ -337,7 +388,7 @@ class HybridBlock(Block):
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix, params)
         self.__dict__["_active"] = False
-        self.__dict__["_jit_cache"] = {}
+        self.__dict__["_jit_cache"] = LRUTraceCache()
         self.__dict__["_hybrid_config"] = {}
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
@@ -346,7 +397,7 @@ class HybridBlock(Block):
         memory statically, so they are implied. backend= (optimize_for) has
         no meaning — XLA is the only backend."""
         self._active = active
-        self._jit_cache = {}
+        self._jit_cache = LRUTraceCache()
         self.__dict__["_hybrid_params"] = None  # re-snapshot on next call
         self._hybrid_config = dict(static_alloc=static_alloc,
                                    static_shape=static_shape, **kwargs)

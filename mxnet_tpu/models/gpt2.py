@@ -129,6 +129,26 @@ class GPT2Attention(HybridBlock):
         k = self._split(self.key(x))
         v = self._split(self.value(x))
         t = q.shape[2]
+        if getattr(cache, "ragged", False):
+            # ragged serving decode: each slot appends at its OWN length
+            # and attends only its live pages through the ragged paged-
+            # attention kernel — no dense (B, T_max) gather at all.
+            if t != 1:
+                raise MXNetError("ragged caches decode one token per "
+                                 "step; prefill slots individually "
+                                 "(serving.ServingEngine)")
+            from ..ops.pallas_attention import ragged_decode_attention
+            cache = cache.write_decode(layer_idx, k._data, v._data)
+            impl = cache.attn_impl
+            out = ragged_decode_attention(
+                q._data[:, :, 0, :].astype(cache.k_pages.dtype),
+                cache.k_pages[layer_idx], cache.v_pages[layer_idx],
+                cache.page_table, cache.length + 1,
+                impl="pallas" if impl == "pallas_interpret" else impl,
+                interpret=impl == "pallas_interpret")
+            b, h, d = out.shape
+            out = out.astype(q._data.dtype).reshape(b, 1, h * d)
+            return self.proj(NDArray(out)), cache
         if t > 1:
             k_all, v_all, cache = cache.write_prompt(
                 layer_idx, k._data, v._data)
@@ -198,7 +218,12 @@ class GPT2Model(HybridBlock):
     def forward(self, inputs, cache=None):
         b, t = inputs.shape
         start = cache.length if cache is not None else 0
-        positions = NDArray(start + jnp.arange(t, dtype=jnp.int32))
+        if cache is not None and cache.ragged:
+            # per-slot positions: slot b's token sits at its own length
+            positions = NDArray(start[:, None]
+                                + jnp.arange(t, dtype=jnp.int32))
+        else:
+            positions = NDArray(start + jnp.arange(t, dtype=jnp.int32))
         x = self.word_embed(inputs) + self.position_embed(positions)
         if self.embed_dropout is not None:
             x = self.embed_dropout(x)
@@ -229,11 +254,12 @@ class GPT2ForCausalLM(HybridBlock):
 
     # -- decode -----------------------------------------------------------
     def make_cache(self, batch, max_length, paged=False, page_size=64,
-                   dtype=None, page_table=None):
+                   dtype=None, page_table=None, lengths=None,
+                   attn_impl="auto"):
         c = self.config
         cls = PagedKVCache if paged else KVCache
-        kw = dict(page_size=page_size, page_table=page_table) if paged \
-            else {}
+        kw = dict(page_size=page_size, page_table=page_table,
+                  lengths=lengths, attn_impl=attn_impl) if paged else {}
         return cls.create(c.num_layers, batch, c.num_heads, max_length,
                           c.units // c.num_heads,
                           dtype=dtype or jnp.dtype(c.dtype), **kw)
@@ -359,8 +385,16 @@ class GPT2ForCausalLM(HybridBlock):
                 for p, d in zip(params, saved):
                     p._data = d
 
+        import os as _os
         key = jax.random.PRNGKey(seed)
-        jitted = self.__dict__.setdefault("_generate_cache", {})
+        # bounded: (B, T0, sampling-config, mesh) churn across serving-
+        # style callers must not grow the cache without limit
+        jitted = self.__dict__.get("_generate_cache")
+        if jitted is None:
+            from ..gluon.block import LRUTraceCache
+            jitted = LRUTraceCache(
+                int(_os.environ.get("MXNET_TPU_GENERATE_CACHE_SIZE", 16)))
+            self.__dict__["_generate_cache"] = jitted
         # Mesh and PartitionSpec hash by value, so equal meshes share the
         # compiled program, and changing sharding rules between calls
         # compiles a fresh one instead of reusing stale in_shardings

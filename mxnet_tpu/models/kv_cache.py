@@ -17,6 +17,16 @@ donation):
     the serving-style layout (cf. ragged paged attention, PAPERS.md)
     with O(1) append and no per-length recompilation.
 
+    RAGGED mode (the continuous-batching serving path, serving/engine.py):
+    `length` may be a (B,) int32 vector — each slot has its own live
+    length. Ragged caches take decode writes through `write_decode`
+    (per-slot scatter at each slot's own offset, NO dense gather) and
+    attention reads the pools directly via the ragged paged-attention
+    kernel (ops/pallas_attention.ragged_decode_attention), so per-token
+    HBM traffic scales with live length instead of max_length. The
+    static `attn_impl` knob ('auto'|'pallas'|'pallas_interpret'|'xla')
+    rides in the pytree aux so it is part of the jit signature.
+
 Both share the same API so models are cache-agnostic:
     write(layer, k_new, v_new)  -> (k_all, v_all, new_cache)
     write_prompt(layer, k, v)   -> (k_all, v_all, new_cache)  # prefill
@@ -54,6 +64,8 @@ class KVCache:
     def max_length(self):
         return self.k.shape[3]
 
+    ragged = False  # contiguous caches are always lockstep
+
     def write(self, layer, k_new, v_new):
         """Write one step: k_new/v_new (B, H, t, D) at offset `length`.
         Returns the FULL (B, H, T_max, D) views + the updated cache."""
@@ -88,18 +100,22 @@ class KVCache:
 @jax.tree_util.register_pytree_node_class
 class PagedKVCache:
     """Page-pool cache: k/v pools (L, num_pages, page_size, H, D) indexed
-    through a per-sequence page_table (B, pages_per_seq)."""
+    through a per-sequence page_table (B, pages_per_seq). `length` is a
+    scalar (all sequences in lockstep — generate()'s fixed-batch decode)
+    or a (B,) vector (ragged serving decode, one live length per slot)."""
 
-    def __init__(self, k_pages, v_pages, page_table, length):
+    def __init__(self, k_pages, v_pages, page_table, length,
+                 attn_impl="auto"):
         self.k_pages = k_pages
         self.v_pages = v_pages
         self.page_table = page_table
         self.length = length
+        self.attn_impl = attn_impl
 
     @classmethod
     def create(cls, num_layers, batch, num_heads, max_length, head_dim,
                dtype=jnp.float32, page_size=64, num_pages=None,
-               page_table=None):
+               page_table=None, lengths=None, attn_impl="auto"):
         if max_length % page_size:
             raise MXNetError(
                 f"max_length {max_length} not a multiple of page_size "
@@ -117,9 +133,15 @@ class PagedKVCache:
                 raise MXNetError(
                     f"{num_pages} pages < {batch}x{per_seq} required")
         shape = (num_layers, num_pages, page_size, num_heads, head_dim)
+        length = jnp.zeros((), jnp.int32) if lengths is None \
+            else jnp.asarray(lengths, jnp.int32)
         return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
-                   jnp.asarray(page_table, jnp.int32),
-                   jnp.zeros((), jnp.int32))
+                   jnp.asarray(page_table, jnp.int32), length,
+                   attn_impl=attn_impl)
+
+    @property
+    def ragged(self):
+        return getattr(self.length, "ndim", 0) == 1
 
     @property
     def page_size(self):
@@ -148,8 +170,37 @@ class PagedKVCache:
             k_t.astype(self.k_pages.dtype))
         vp = self.v_pages.at[layer, pages, slot].set(
             v_t.astype(self.v_pages.dtype))
-        new = PagedKVCache(kp, vp, self.page_table, self.length)
+        new = PagedKVCache(kp, vp, self.page_table, self.length,
+                           attn_impl=self.attn_impl)
         return new._gather(kp, layer), new._gather(vp, layer), new
+
+    def write_decode(self, layer, k_new, v_new):
+        """Ragged decode write: each slot appends its token at its OWN
+        length. k_new/v_new (B, H, 1, D). Returns just the updated cache
+        — no gathered views (the ragged attention kernel reads the pools
+        directly; materializing the dense view is exactly the HBM cost
+        this path removes). Slots already at capacity scatter out of
+        bounds and the write DROPS (mode='drop') instead of clobbering a
+        live page."""
+        B = k_new.shape[0]
+        S = self.page_size
+        P = self.page_table.shape[1]
+        length = self.length if self.ragged \
+            else jnp.broadcast_to(self.length, (B,))
+        page_idx = length // S                        # (B,)
+        slot = length % S                             # (B,)
+        safe = self.page_table[jnp.arange(B), jnp.minimum(page_idx, P - 1)]
+        num_pages = self.k_pages.shape[1]
+        # full slots get an out-of-range pool page → scatter drops
+        pages = jnp.where(page_idx < P, safe, num_pages)
+        k_t = k_new[:, :, 0, :]                       # (B, H, D)
+        v_t = v_new[:, :, 0, :]
+        kp = self.k_pages.at[layer, pages, slot].set(
+            k_t.astype(self.k_pages.dtype), mode="drop")
+        vp = self.v_pages.at[layer, pages, slot].set(
+            v_t.astype(self.v_pages.dtype), mode="drop")
+        return PagedKVCache(kp, vp, self.page_table, self.length,
+                            attn_impl=self.attn_impl)
 
     def write_prompt(self, layer, k, v):
         """Prefill write of a whole (B, H, T, D) prompt starting at
@@ -167,20 +218,26 @@ class PagedKVCache:
         tbl = self.page_table[:, :n_pages]            # (B, nP)
         kp = self.k_pages.at[layer, tbl].set(kq.astype(self.k_pages.dtype))
         vp = self.v_pages.at[layer, tbl].set(vq.astype(self.v_pages.dtype))
-        new = PagedKVCache(kp, vp, self.page_table, self.length)
+        new = PagedKVCache(kp, vp, self.page_table, self.length,
+                           attn_impl=self.attn_impl)
         return new._gather(kp, layer), new._gather(vp, layer), new
 
     def advance(self, n):
         return PagedKVCache(self.k_pages, self.v_pages, self.page_table,
-                            self.length + n)
+                            self.length + n, attn_impl=self.attn_impl)
 
     def key_mask(self, extra=0):
-        return jnp.arange(self.max_length) < (self.length + extra)
+        """Validity over key positions: (T_max,) in lockstep mode,
+        (B, T_max) in ragged mode."""
+        pos = jnp.arange(self.max_length)
+        if self.ragged:
+            return pos[None, :] < (self.length + extra)[:, None]
+        return pos < (self.length + extra)
 
     def tree_flatten(self):
         return (self.k_pages, self.v_pages, self.page_table,
-                self.length), None
+                self.length), self.attn_impl
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children)
+        return cls(*children, attn_impl=aux)

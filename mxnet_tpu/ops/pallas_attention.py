@@ -376,6 +376,183 @@ def _fused_bwd(scale, p_drop, causal, interpret, res, g):
 _fused.defvjp(_fused_fwd, _fused_bwd)
 
 
+# ---------------------------------------------------------------------------
+# ragged paged-attention decode kernel (the serving hot path).
+#
+# One query token per decode slot attends over that slot's live KV pages
+# only. The dense alternative (PagedKVCache._gather) re-materializes the
+# FULL (B, max_length, H, D) cache view from HBM every decoded token — at
+# GPT-2 774M serving shapes that is max_length/live_length times more HBM
+# traffic than the tokens actually alive. This kernel follows the ragged
+# paged attention design (arxiv 2604.15464): grid (slots, pages-per-slot),
+# the page table and per-slot lengths ride in scalar-prefetch SMEM so the
+# BlockSpec index_map DMAs exactly the pages the slot owns, and pages past
+# the live length re-map to the slot's last live page — Pallas elides the
+# DMA when consecutive grid steps ask for the same block, so per-token HBM
+# traffic scales with the LIVE length, not max_length.
+#
+# Layout: pages enter packed as (num_pages, S, H*D) (a free minor-dim
+# reshape of the pool's (num_pages, S, H, D)); heads are static 64-aligned
+# column slices exactly like the packed training kernels above, so the
+# (8, 128) Mosaic rule holds for every transformer width. The online-
+# softmax accumulators live in VMEM scratch and persist across the
+# sequential minor page-grid dimension.
+# ---------------------------------------------------------------------------
+
+def _ragged_decode_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                          m_ref, l_ref, acc_ref, *, scale, S, H, D):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    length = len_ref[b]
+    n_live = (length + S - 1) // S
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(p < n_live)
+    def _accumulate():
+        # token positions covered by this page, masked to the live length
+        pos = p * S + lax.broadcasted_iota(jnp.int32, (1, S), 1)
+        valid = pos < length
+        for h in range(H):
+            c0, c1 = h * D, (h + 1) * D
+            q = q_ref[0, :, c0:c1]                     # (1, D)
+            k = k_ref[0, :, c0:c1]                     # (S, D)
+            s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+            s = jnp.where(valid, s, NEG_INF)           # (1, S)
+            m_prev = m_ref[h, 0]
+            l_prev = l_ref[h, 0]
+            m_new = jnp.maximum(m_prev, jnp.max(s))
+            # fully-masked page rows contribute zeros, not exp(0)
+            e = jnp.where(m_new <= NEG_INF / 2, 0.0, jnp.exp(s - m_new))
+            alpha = jnp.where(m_new <= NEG_INF / 2, 1.0,
+                              jnp.exp(m_prev - m_new))
+            v = v_ref[0, :, c0:c1]                     # (S, D)
+            pv = lax.dot_general(e.astype(v.dtype), v,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+            acc_ref[h:h + 1, :] = acc_ref[h:h + 1, :] * alpha + pv
+            l_ref[h, 0] = l_prev * alpha + jnp.sum(e)
+            m_ref[h, 0] = m_new
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _emit():
+        for h in range(H):
+            c0, c1 = h * D, (h + 1) * D
+            # empty slots (length 0) keep acc == 0 → emit zeros
+            o_ref[0, :, c0:c1] = (
+                acc_ref[h:h + 1, :]
+                / jnp.maximum(l_ref[h, 0], 1e-30)).astype(o_ref.dtype)
+
+
+def ragged_supported(q, k_pages):
+    """Can the ragged Pallas decode kernel take this call on real TPU
+    hardware? (Interpret mode runs any shape.)"""
+    H, D = q.shape[1], q.shape[2]
+    S = k_pages.shape[1]
+    if (H * D) % 128 or D % 64:
+        return False   # packed head slices must be 64-aligned lane blocks
+    if S % 8:
+        return False   # sublane rule for the (S, H*D) page blocks
+    if q.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    return True
+
+
+def _ragged_reference(q, k_pages, v_pages, page_table, lengths, scale):
+    """Dense XLA fallback/oracle: gather the full per-slot views and mask
+    by length — the exact math the kernel computes, O(max_length) HBM."""
+    B = q.shape[0]
+    g = jnp.take(k_pages, page_table, axis=0)          # (B, P, S, H, D)
+    P, S = g.shape[1], g.shape[2]
+    k = g.reshape(B, P * S, *g.shape[3:])              # (B, T, H, D)
+    v = jnp.take(v_pages, page_table, axis=0).reshape(B, P * S,
+                                                      *g.shape[3:])
+    s = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    mask = (jnp.arange(P * S)[None, :] < lengths[:, None])[:, None, :]
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(s - m))
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    w = e / jnp.maximum(l, 1e-30)
+    return jnp.einsum("bht,bthd->bhd", w,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def ragged_decode_attention(q, k_pages, v_pages, page_table, lengths,
+                            scale=None, impl="auto", interpret=False):
+    """Ragged paged-attention for one decode step.
+
+    q:              (B, H, D) — the current token's query per slot.
+    k_pages/v_pages:(num_pages, S, H, D) — ONE layer's page pools.
+    page_table:     (B, P) int32 — physical pages per slot.
+    lengths:        (B,) int32 — LIVE tokens per slot, including the
+                    token just written (a slot with length 0 yields 0s).
+    impl: 'auto' (kernel on TPU when shapes allow, dense XLA otherwise),
+    'pallas' (force the kernel; interpret=True runs it on CPU), 'xla'.
+    Returns (B, H, D) in q's dtype.
+    """
+    B, H, D = q.shape
+    N, S = k_pages.shape[0], k_pages.shape[1]
+    P = page_table.shape[1]
+    s = float(scale) if scale is not None else 1.0 / math.sqrt(D)
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu" and not interpret
+        impl = "pallas" if (on_tpu and ragged_supported(q, k_pages)) \
+            else ("pallas" if interpret else "xla")
+    if impl == "xla":
+        return _ragged_reference(q, k_pages, v_pages, page_table,
+                                 lengths, s)
+    if impl != "pallas":
+        raise ValueError(f"unknown ragged attention impl {impl!r}")
+    qp = q.reshape(B, 1, H * D)
+    kp = k_pages.reshape(N, S, H * D)
+    vp = v_pages.reshape(N, S, H * D)
+    lengths = lengths.astype(jnp.int32)
+    table = page_table.astype(jnp.int32)
+
+    def page_index(b, p, tbl, lens):
+        # pages past the live length re-map to the last live page: the
+        # block index repeats, so the pipeline skips the DMA (ragged
+        # traffic). Empty slots (length 0) pin to the slot's first page.
+        last_live = jnp.maximum((lens[b] + S - 1) // S - 1, 0)
+        return (tbl[b, jnp.minimum(p, last_live)], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, P),
+        in_specs=[
+            pl.BlockSpec((1, 1, H * D), lambda b, p, tbl, lens: (b, 0, 0)),
+            pl.BlockSpec((1, S, H * D), page_index),
+            pl.BlockSpec((1, S, H * D), page_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, H * D),
+                               lambda b, p, tbl, lens: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 128), jnp.float32),   # running max (lane 0)
+            pltpu.VMEM((H, 128), jnp.float32),   # running denominator
+            pltpu.VMEM((H, D), jnp.float32),     # running numerator
+        ],
+    )
+    kernel = functools.partial(_ragged_decode_kernel, scale=s, S=S, H=H,
+                               D=D)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, 1, H * D), q.dtype),
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+            dimension_semantics=("parallel", "arbitrary")),
+    )(table, lengths, qp, kp, vp)
+    return out.reshape(B, H, D)
+
+
 def supported(q, k, mask, layout="BHTD"):
     """Can the fused kernel take this call? (shape/dtype/mask gate —
     dropout works on every supported shape, so it is not a criterion)"""

@@ -9,7 +9,24 @@ from __future__ import annotations
 
 from .base import MXNetError
 
-__all__ = ["Feature", "Features", "feature_list"]
+__all__ = ["Feature", "Features", "feature_list", "jit_cache_stats",
+           "reset_jit_cache_stats"]
+
+
+def jit_cache_stats():
+    """Process-wide trace-cache counters ({'retraces', 'evictions'}) for
+    the bounded LRU jit caches (HybridBlock._jit_cache and
+    GPT2._generate_cache). A steadily climbing retrace count in steady
+    state means shape churn is defeating the caches — pad or bucket the
+    inputs. Bound sizes: MXNET_TPU_JIT_CACHE_SIZE (default 64) and
+    MXNET_TPU_GENERATE_CACHE_SIZE (default 16)."""
+    from .gluon.block import jit_cache_stats as _stats
+    return _stats()
+
+
+def reset_jit_cache_stats():
+    from .gluon.block import reset_jit_cache_stats as _reset
+    _reset()
 
 
 class Feature:

@@ -1,0 +1,19 @@
+"""mxnet_tpu.serving — continuous-batching inference engine.
+
+The serving-side counterpart of parallel.TrainStep: where training
+compiles the whole optimizer step into one XLA program, serving compiles
+prefill (per prompt bucket) and a K-step decode block (lax.scan) into
+cached programs and keeps the host out of the token loop. Requests are
+admitted into fixed decode slots between compiled dispatches; each slot
+decodes against its own live length through the ragged paged-attention
+kernel (ops/pallas_attention.ragged_decode_attention), so finished
+sequences stop costing HBM the moment their slot is freed.
+
+See docs/SERVING.md for the architecture and slot lifecycle.
+"""
+from .sampling import sample_tokens, slot_keys  # noqa: F401
+from .scheduler import Request, SlotScheduler  # noqa: F401
+from .engine import ServingEngine  # noqa: F401
+
+__all__ = ["Request", "SlotScheduler", "ServingEngine", "sample_tokens",
+           "slot_keys"]

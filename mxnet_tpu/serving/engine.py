@@ -1,0 +1,336 @@
+"""Continuous-batching serving engine.
+
+Execution model (docs/SERVING.md):
+
+  * B fixed decode SLOTS share one PagedKVCache page pool. Each slot has
+    its own live length; the decode forward runs all B slots through the
+    ragged paged-attention kernel, so per-token HBM traffic is the sum
+    of LIVE lengths, not B × max_length.
+  * PREFILL is one compiled program per prompt-length bucket: it writes
+    the prompt's KV into the slot's pages (batch-1, attention only over
+    the bucket) and samples the request's first token.
+  * DECODE runs K steps per host dispatch via lax.scan — the
+    TrainStep.run_steps pattern applied to serving. PERF_NOTES measured
+    ~24 ms/step of host dispatch tax over a remote tunnel; at one
+    token per step that tax would dominate decode, so the block size K
+    amortizes it K-fold.
+  * Between dispatches the host frees finished slots and admits queued
+    requests (FIFO) — continuous batching: nobody waits for the slowest
+    sequence in a fixed batch.
+
+Everything per-request (sampling knobs, seeds, eos, budgets) is a
+per-slot ARRAY in the compiled program, so admission never recompiles;
+the only shape-churn axis is the prefill bucket, and those programs live
+in a bounded LRU (gluon.block.LRUTraceCache).
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from ..gluon.block import LRUTraceCache, _trace_channel
+from ..models.kv_cache import PagedKVCache
+from ..ndarray.ndarray import NDArray
+from .sampling import sample_tokens, slot_keys
+from .scheduler import Request, SlotScheduler
+
+__all__ = ["ServingEngine"]
+
+
+class ServingEngine:
+    """Continuous-batching generation over a model with the GPT-2 cache
+    contract (forward(ids, cache) -> (logits, cache), make_cache()).
+
+    num_slots: concurrent decode sequences (the compiled batch).
+    max_length: per-slot KV capacity (prompt + generated), rounded down
+        to a whole number of pages; defaults to the model's max_length.
+    page_size: KV page granularity. decode_block: decode steps fused
+    into one dispatch. attn_impl: 'auto' (ragged Pallas kernel on TPU,
+    dense XLA elsewhere), 'pallas', 'pallas_interpret' (the kernel in
+    interpret mode — CPU tests), or 'xla'.
+    """
+
+    def __init__(self, model, num_slots, max_length=None, page_size=64,
+                 decode_block=8, attn_impl="auto", prefill_bucket=None,
+                 dtype=None):
+        self.model = model
+        cfg = model.config
+        self.num_slots = int(num_slots)
+        max_length = int(max_length or cfg.max_length)
+        max_length -= max_length % page_size
+        if max_length < page_size:
+            raise MXNetError(f"max_length {max_length} < one page "
+                             f"({page_size})")
+        if max_length > cfg.max_length:
+            raise MXNetError(f"max_length {max_length} exceeds the "
+                             f"model's position range {cfg.max_length}")
+        self.max_length = max_length
+        self.page_size = int(page_size)
+        self.decode_block = int(decode_block)
+        if self.decode_block < 1:
+            raise MXNetError("decode_block must be >= 1")
+        self.attn_impl = attn_impl
+        self.prefill_bucket = int(prefill_bucket or page_size)
+        self.scheduler = SlotScheduler(num_slots)
+
+        self._params = list(model.collect_params().values())
+        B = self.num_slots
+        P = max_length // page_size
+        dt = dtype or jnp.dtype(cfg.dtype)
+        pool_shape = (cfg.num_layers, B * P, page_size, cfg.num_heads,
+                      cfg.units // cfg.num_heads)
+        self._kp = jnp.zeros(pool_shape, dt)
+        self._vp = jnp.zeros(pool_shape, dt)
+        self._table = jnp.arange(B * P, dtype=jnp.int32).reshape(B, P)
+        # per-slot host state (tiny; uploaded per dispatch, fetched back
+        # with the decoded tokens — one round trip per K tokens)
+        self._lengths = np.zeros(B, np.int32)
+        self._cur_tok = np.zeros(B, np.int32)
+        self._done = np.ones(B, bool)          # free slots are inactive
+        self._remaining = np.zeros(B, np.int32)
+        self._counters = np.zeros(B, np.int32)
+        self._seeds = np.zeros(B, np.int32)
+        self._temp = np.ones(B, np.float32)
+        self._top_k = np.zeros(B, np.int32)
+        self._top_p = np.ones(B, np.float32)
+        self._do_sample = np.zeros(B, bool)
+        self._eos = np.full(B, -1, np.int32)
+
+        self._prefill_programs = LRUTraceCache(
+            max(2 * (max_length // self.prefill_bucket), 8))
+        self._decode_program = None
+        self.stats = {"prefills": 0, "decode_dispatches": 0,
+                      "decode_steps": 0, "tokens_emitted": 0,
+                      "requests_finished": 0}
+
+    # -- public API --------------------------------------------------------
+    def submit(self, request):
+        """Queue a Request (validated against this engine's capacity)."""
+        if request.prompt_len > self.max_length:
+            raise MXNetError(
+                f"prompt of {request.prompt_len} tokens exceeds slot "
+                f"capacity {self.max_length}")
+        request.t_submit = time.perf_counter()
+        request.output_tokens = []
+        request.token_times = []
+        return self.scheduler.submit(request)
+
+    @property
+    def has_work(self):
+        return self.scheduler.has_work
+
+    def step(self):
+        """One scheduling round: admit free slots (prefill), run one
+        K-step decode block, free finished slots. Returns the requests
+        that finished this round."""
+        finished = []
+        for slot, req in self.scheduler.admit():
+            fin = self._admit(slot, req)
+            if fin is not None:
+                finished.append(fin)
+        if self.scheduler.num_active:
+            finished.extend(self._decode_block())
+        return finished
+
+    def serve(self, requests=()):
+        """Submit `requests`, run until the queue and all slots drain,
+        and return every finished request (submission order)."""
+        for r in requests:
+            self.submit(r)
+        done = []
+        while self.has_work:
+            done.extend(self.step())
+        done.sort(key=lambda r: r.t_submit)
+        return done
+
+    def generate(self, prompts, max_new_tokens, **request_kw):
+        """Convenience: serve a list of prompts with shared settings and
+        return their generated token lists in order."""
+        reqs = [Request(p, max_new_tokens, **request_kw) for p in prompts]
+        by_id = {r.id: r for r in reqs}
+        self.serve(reqs)
+        return [by_id[r.id].output_tokens for r in reqs]
+
+    # -- prefill -----------------------------------------------------------
+    def _bucket(self, n):
+        b = self.prefill_bucket
+        return min(((n + b - 1) // b) * b, self.max_length)
+
+    def _build_prefill(self, t_bucket):
+        model, params = self.model, self._params
+        table = self._table
+        n_pages = t_bucket // self.page_size
+
+        def prefill(param_arrays, kp, vp, ids, slot, true_len, seed,
+                    temp, top_k, top_p, do_sample, eos):
+            saved = [p._data for p in params]
+            _trace_channel.push_frame()
+            try:
+                for p, d in zip(params, param_arrays):
+                    arr = NDArray(d)
+                    arr._grad_req = "null"
+                    p._data = arr
+                row = jnp.take(table, slot, axis=0)       # (P,)
+                cache = PagedKVCache(kp, vp, row[None, :n_pages],
+                                     jnp.zeros((), jnp.int32),
+                                     attn_impl=self.attn_impl)
+                logits, cache = model.forward(NDArray(ids), cache)
+            finally:
+                _trace_channel.pop_frame()
+                for p, d in zip(params, saved):
+                    p._data = d
+            last = jnp.take(logits._data[0], true_len - 1, axis=0)
+            key = slot_keys(seed[None], jnp.zeros((1,), jnp.int32))
+            first = sample_tokens(last[None], key, do_sample[None],
+                                  temp[None], top_k[None], top_p[None])[0]
+            done0 = (first == eos) & (eos >= 0)
+            return cache.k_pages, cache.v_pages, first, done0
+
+        return jax.jit(prefill, donate_argnums=(1, 2))
+
+    def _admit(self, slot, req):
+        Tp = req.prompt_len
+        Tb = self._bucket(Tp)
+        ids = np.zeros((1, Tb), np.int32)
+        ids[0, :Tp] = req.prompt
+        fn = self._prefill_programs.get(Tb)
+        if fn is None:
+            fn = self._build_prefill(Tb)
+            self._prefill_programs[Tb] = fn
+        param_datas = tuple(p.data()._data for p in self._params)
+        i32 = lambda v: jnp.asarray(v, jnp.int32)  # noqa: E731
+        kp, vp, first, done0 = fn(
+            param_datas, self._kp, self._vp, jnp.asarray(ids), i32(slot),
+            i32(Tp), i32(req.seed), jnp.asarray(req.temperature,
+                                                jnp.float32),
+            i32(req.top_k), jnp.asarray(req.top_p, jnp.float32),
+            jnp.asarray(req.do_sample), i32(
+                -1 if req.eos_token_id is None else req.eos_token_id))
+        self._kp, self._vp = kp, vp
+        first = int(first)
+        now = time.perf_counter()
+        req.t_admit = now
+        req.output_tokens.append(first)
+        req.token_times.append(now)
+        self.stats["prefills"] += 1
+        self.stats["tokens_emitted"] += 1
+        # budget: every decode step writes one KV; the last sampled token
+        # is never written, so a prompt of Tp supports up to
+        # max_length - Tp + 1 generated tokens
+        cap = min(req.max_new_tokens, self.max_length - Tp + 1)
+        self._lengths[slot] = Tp
+        self._cur_tok[slot] = first
+        self._remaining[slot] = cap - 1
+        self._counters[slot] = 1
+        self._seeds[slot] = req.seed
+        self._temp[slot] = req.temperature
+        self._top_k[slot] = req.top_k
+        self._top_p[slot] = req.top_p
+        self._do_sample[slot] = req.do_sample
+        self._eos[slot] = -1 if req.eos_token_id is None \
+            else req.eos_token_id
+        self._done[slot] = bool(done0) or cap <= 1
+        if self._done[slot]:
+            return self._finish(slot)
+        return None
+
+    # -- decode ------------------------------------------------------------
+    def _build_decode(self):
+        model, params = self.model, self._params
+        table, K = self._table, self.decode_block
+        impl = self.attn_impl
+
+        def decode(param_arrays, kp, vp, lengths, cur_tok, done,
+                   remaining, counters, seeds, temp, top_k, top_p,
+                   do_sample, eos):
+            saved = [p._data for p in params]
+            _trace_channel.push_frame()
+            try:
+                for p, d in zip(params, param_arrays):
+                    arr = NDArray(d)
+                    arr._grad_req = "null"
+                    p._data = arr
+
+                def body(carry, _):
+                    (kp, vp, lengths, cur_tok, done, remaining,
+                     counters) = carry
+                    active = (~done) & (remaining > 0)
+                    cache = PagedKVCache(kp, vp, table, lengths,
+                                         attn_impl=impl)
+                    tok_in = jnp.where(active, cur_tok, 0)
+                    logits, cache = model.forward(
+                        NDArray(tok_in[:, None]), cache)
+                    keys = slot_keys(seeds, counters)
+                    nxt = sample_tokens(logits._data[:, -1, :], keys,
+                                        do_sample, temp, top_k, top_p)
+                    new_len = jnp.where(active, cache.length, lengths)
+                    new_rem = jnp.where(active, remaining - 1, remaining)
+                    hit_eos = (nxt == eos) & (eos >= 0)
+                    new_done = done | (active & (hit_eos
+                                                 | (new_rem <= 0)))
+                    carry = (cache.k_pages, cache.v_pages, new_len,
+                             jnp.where(active, nxt, cur_tok), new_done,
+                             new_rem,
+                             jnp.where(active, counters + 1, counters))
+                    return carry, (jnp.where(active, nxt, -1), active)
+
+                init = (kp, vp, lengths, cur_tok, done, remaining,
+                        counters)
+                final, (toks, valid) = lax.scan(body, init, None,
+                                                length=K)
+            finally:
+                _trace_channel.pop_frame()
+                for p, d in zip(params, saved):
+                    p._data = d
+            return final + (toks, valid)
+
+        return jax.jit(decode, donate_argnums=(1, 2))
+
+    def _decode_block(self):
+        if self._decode_program is None:
+            self._decode_program = self._build_decode()
+        param_datas = tuple(p.data()._data for p in self._params)
+        out = self._decode_program(
+            param_datas, self._kp, self._vp, jnp.asarray(self._lengths),
+            jnp.asarray(self._cur_tok), jnp.asarray(self._done),
+            jnp.asarray(self._remaining), jnp.asarray(self._counters),
+            jnp.asarray(self._seeds), jnp.asarray(self._temp),
+            jnp.asarray(self._top_k), jnp.asarray(self._top_p),
+            jnp.asarray(self._do_sample), jnp.asarray(self._eos))
+        (self._kp, self._vp, lengths, cur_tok, done, remaining, counters,
+         toks, valid) = out
+        # ONE host sync per K decoded tokens: everything small fetches
+        # together (the pools stay on device, donated through)
+        (self._lengths, self._cur_tok, self._done, self._remaining,
+         self._counters) = (
+            np.array(lengths), np.array(cur_tok), np.array(done),
+            np.array(remaining), np.array(counters))
+        toks, valid = np.asarray(toks), np.asarray(valid)
+        now = time.perf_counter()
+        self.stats["decode_dispatches"] += 1
+        self.stats["decode_steps"] += self.decode_block
+        finished = []
+        for slot in self.scheduler.active_slots:
+            req = self.scheduler.request_at(slot)
+            emitted = toks[valid[:, slot], slot]
+            req.output_tokens.extend(int(t) for t in emitted)
+            req.token_times.extend([now] * emitted.size)
+            self.stats["tokens_emitted"] += int(emitted.size)
+            if self._done[slot] or self._remaining[slot] <= 0:
+                finished.append(self._finish(slot))
+        return finished
+
+    def _finish(self, slot):
+        req = self.scheduler.release(slot)
+        req.t_finish = time.perf_counter()
+        # freed slots stay inactive (and write nothing) until re-admitted
+        self._done[slot] = True
+        self._remaining[slot] = 0
+        self.stats["requests_finished"] += 1
+        return req

@@ -1,0 +1,65 @@
+"""Per-slot token sampling for the continuous-batching engine.
+
+Every sampling knob is a PER-SLOT ARRAY, not a compile-time constant, so
+one compiled decode program serves any mix of greedy and sampled
+requests at any temperature/top-k/top-p — admission never recompiles.
+
+RNG contract (the reproducibility satellite): token i of a request with
+seed s is drawn with key fold_in(PRNGKey(s), i). The stream depends ONLY
+on the request's own (seed, token index) — never on the slot it landed
+in, the admission order, or which other requests share the batch — so
+sampled output is bit-reproducible across schedules. This is the same
+counter-derivation discipline the Pallas dropout kernels apply per
+(batch, head) grid cell, keyed here by the logical request instead of
+the physical slot.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["slot_keys", "sample_tokens"]
+
+
+def slot_keys(seeds, counters):
+    """(B,) int32 request seeds × (B,) int32 per-request token indices →
+    (B,) PRNG keys, one independent stream element per slot."""
+    def one(seed, counter):
+        return jax.random.fold_in(jax.random.PRNGKey(seed), counter)
+    return jax.vmap(one)(seeds, counters)
+
+
+def sample_tokens(logits, keys, do_sample, temperature, top_k, top_p):
+    """Select one token per slot from (B, V) logits.
+
+    keys: (B,) PRNG keys (slot_keys). do_sample: (B,) bool — False rows
+    take argmax. temperature: (B,) f32 (> 0; greedy rows ignore it).
+    top_k: (B,) int32, <= 0 disables. top_p: (B,) f32, >= 1 disables
+    (the full distribution must be a true no-op: f32 cumsum rounding
+    above 1.0 would otherwise cut tail tokens — same guard as
+    GPT2.generate). Returns (B,) int32.
+    """
+    logits = logits.astype(jnp.float32)
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    # ONE descending sort serves both filters (per decode step inside the
+    # compiled block — don't sort twice)
+    sort_idx = jnp.argsort(-scaled, axis=-1)
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    cut_sorted = jnp.zeros((B, V), bool)
+    ranks = jnp.arange(V)[None, :]
+    cut_sorted |= (ranks >= top_k[:, None]) & (top_k > 0)[:, None]
+    # nucleus: cut token i only if the mass STRICTLY before it already
+    # exceeds top_p — the top-1 token always survives (even top_p=0)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cut_sorted |= ((cum - probs) > top_p[:, None]) & (top_p < 1.0)[:, None]
+    cut = jnp.zeros_like(cut_sorted).at[
+        jnp.arange(B)[:, None], sort_idx].set(cut_sorted)
+    filtered = jnp.where(cut, -jnp.inf, scaled)
+
+    sampled = jax.vmap(
+        lambda key, row: jax.random.categorical(key, row))(keys, filtered)
+    return jnp.where(do_sample, sampled.astype(jnp.int32), greedy)

@@ -1,0 +1,129 @@
+"""Slot scheduler for continuous batching.
+
+The engine decodes a FIXED batch of B slots (one compiled program, no
+shape churn); the scheduler owns which request occupies which slot.
+Admission is strict FIFO — the oldest queued request always gets the
+next free slot, so a steady stream of new arrivals can never starve an
+earlier one. Slots free the moment their request finishes (eos or token
+budget), and a freed slot is re-admittable between two compiled decode
+dispatches — the continuous-batching property: a finished sequence
+never burns its slot waiting for the slowest member of its batch.
+"""
+from __future__ import annotations
+
+import itertools
+from collections import deque
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["Request", "SlotScheduler"]
+
+_req_counter = itertools.count()
+
+
+class Request:
+    """One generation request.
+
+    prompt: 1-D int sequence. max_new_tokens: generation budget
+    (including the first token sampled at prefill). Sampling knobs are
+    per-request and dynamic — they never recompile the engine. seed
+    drives this request's private RNG stream (see serving.sampling).
+    eos_token_id=None disables eos stopping for this request.
+    """
+
+    def __init__(self, prompt, max_new_tokens, request_id=None,
+                 do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+                 seed=0, eos_token_id=None):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise MXNetError("Request needs a non-empty prompt")
+        if max_new_tokens < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        if temperature <= 0:
+            raise MXNetError("temperature must be > 0 (use "
+                             "do_sample=False for greedy)")
+        self.max_new_tokens = int(max_new_tokens)
+        self.id = request_id if request_id is not None \
+            else next(_req_counter)
+        self.do_sample = bool(do_sample)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k or 0)
+        self.top_p = float(top_p if top_p is not None else 1.0)
+        self.seed = int(seed)
+        self.eos_token_id = eos_token_id
+        # filled in by the engine
+        self.output_tokens = []
+        self.t_submit = None
+        self.t_admit = None
+        self.t_finish = None
+
+    @property
+    def prompt_len(self):
+        return int(self.prompt.size)
+
+    def __repr__(self):
+        return (f"Request(id={self.id}, prompt_len={self.prompt_len}, "
+                f"max_new={self.max_new_tokens}, "
+                f"generated={len(self.output_tokens)})")
+
+
+class SlotScheduler:
+    """Fixed-pool slot allocator + FIFO admission queue."""
+
+    def __init__(self, num_slots):
+        if num_slots < 1:
+            raise MXNetError("need at least one decode slot")
+        self.num_slots = int(num_slots)
+        self._free = deque(range(self.num_slots))
+        self._queue = deque()
+        self._active = {}          # slot -> Request
+
+    # -- queue -------------------------------------------------------------
+    def submit(self, request):
+        self._queue.append(request)
+        return request
+
+    def admit(self):
+        """Pair queued requests with free slots, oldest request first.
+        Returns the [(slot, request), ...] admitted this round."""
+        admitted = []
+        while self._free and self._queue:
+            slot = self._free.popleft()
+            req = self._queue.popleft()
+            self._active[slot] = req
+            admitted.append((slot, req))
+        return admitted
+
+    def release(self, slot):
+        """Free a slot whose request finished (or was evicted)."""
+        if slot not in self._active:
+            raise MXNetError(f"slot {slot} is not active")
+        req = self._active.pop(slot)
+        self._free.append(slot)
+        return req
+
+    # -- introspection -----------------------------------------------------
+    def request_at(self, slot):
+        return self._active.get(slot)
+
+    @property
+    def active_slots(self):
+        return sorted(self._active)
+
+    @property
+    def num_active(self):
+        return len(self._active)
+
+    @property
+    def num_free(self):
+        return len(self._free)
+
+    @property
+    def num_queued(self):
+        return len(self._queue)
+
+    @property
+    def has_work(self):
+        return bool(self._queue or self._active)

@@ -1,0 +1,441 @@
+"""Continuous-batching serving engine + ragged paged-attention tests.
+
+The oracle for every decode-path test is the reference's way: a full
+uncached causal forward over the whole prefix (the torch-oracle
+discipline — dtype-aware tolerances, CPU interpret-mode kernels).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import GPT2Config, GPT2ForCausalLM, PagedKVCache
+from mxnet_tpu.ops import pallas_attention as pa
+from mxnet_tpu.serving import Request, ServingEngine, SlotScheduler
+
+
+def _tiny(vocab=97, layers=2, units=32, heads=2, max_len=64):
+    cfg = GPT2Config(vocab_size=vocab, units=units, num_layers=layers,
+                     num_heads=heads, max_length=max_len, dropout=0.0,
+                     attention_dropout=0.0)
+    net = GPT2ForCausalLM(cfg)
+    mx.rng.seed(3)
+    net.initialize(mx.init.Normal(0.05))
+    return net, cfg
+
+
+def _greedy_full(net, prompt, n_new):
+    """Full-recompute greedy decode (the reference oracle)."""
+    ids = np.asarray(prompt, np.int32)[None]
+    out = []
+    for _ in range(n_new):
+        logits = net(mx.nd.array(ids, dtype="int32"))
+        nxt = int(logits.asnumpy()[0, -1].argmax())
+        out.append(nxt)
+        ids = np.concatenate([ids, [[nxt]]], axis=1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ragged paged-attention kernel
+# ---------------------------------------------------------------------------
+
+def _pool(B=3, H=2, D=16, S=8, P=4, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    N = B * P
+    q = jnp.asarray(rng.standard_normal((B, H, D)), dtype)
+    kp = jnp.asarray(rng.standard_normal((N, S, H, D)), dtype)
+    vp = jnp.asarray(rng.standard_normal((N, S, H, D)), dtype)
+    table = jnp.asarray(rng.permutation(N).reshape(B, P), jnp.int32)
+    return q, kp, vp, table
+
+
+@pytest.mark.parametrize("lengths", [[5, 17, 32], [0, 1, 8],
+                                     [32, 32, 32], [0, 0, 0]])
+def test_ragged_kernel_matches_dense_reference(lengths):
+    q, kp, vp, table = _pool()
+    L = jnp.asarray(lengths, jnp.int32)
+    ref = pa._ragged_reference(q, kp, vp, table, L, 1.0 / np.sqrt(16))
+    out = pa.ragged_decode_attention(q, kp, vp, table, L, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_kernel_under_jit_and_scan():
+    """The engine calls the kernel inside jit(lax.scan(...)) — the
+    scalar-prefetch grid must trace there too."""
+    q, kp, vp, table = _pool()
+    L = jnp.asarray([3, 9, 25], jnp.int32)
+
+    def step(carry, _):
+        out = pa.ragged_decode_attention(q, kp, vp, table, carry,
+                                         interpret=True)
+        return carry + 1, out
+
+    _, outs = jax.jit(lambda l: jax.lax.scan(step, l, None, length=2))(L)
+    for i in range(2):
+        ref = pa._ragged_reference(q, kp, vp, table, L + i,
+                                   1.0 / np.sqrt(16))
+        np.testing.assert_allclose(np.asarray(outs[i]), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_kernel_bf16_tolerance():
+    q, kp, vp, table = _pool(dtype=jnp.bfloat16)
+    L = jnp.asarray([7, 20, 13], jnp.int32)
+    ref = pa._ragged_reference(q.astype(jnp.float32),
+                               kp.astype(jnp.float32),
+                               vp.astype(jnp.float32), table, L,
+                               1.0 / np.sqrt(16))
+    out = pa.ragged_decode_attention(q, kp, vp, table, L, interpret=True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_ragged_supported_gating():
+    q, kp, _, _ = _pool(H=2, D=64, S=8)   # H*D = 128
+    assert pa.ragged_supported(q, kp)
+    q2, kp2, _, _ = _pool(H=2, D=16, S=8)  # H*D = 32: lane rule fails
+    assert not pa.ragged_supported(q2, kp2)
+    q3, kp3, _, _ = _pool(H=2, D=64, S=4)  # sublane rule fails
+    assert not pa.ragged_supported(q3, kp3)
+    assert not pa.ragged_supported(q.astype(jnp.int32), kp)
+
+
+# ---------------------------------------------------------------------------
+# ragged cache semantics
+# ---------------------------------------------------------------------------
+
+def test_write_decode_lands_at_per_slot_offsets():
+    B, H, D, S = 3, 1, 2, 4
+    lengths = jnp.asarray([0, 5, 9], jnp.int32)
+    cache = PagedKVCache.create(1, B, H, 12, D, page_size=S,
+                                lengths=lengths)
+    val = jnp.arange(B, dtype=jnp.float32).reshape(B, 1, 1, 1) + 1.0
+    val = jnp.broadcast_to(val, (B, H, 1, D))
+    cache = cache.write_decode(0, val, 2 * val)
+    pool = np.asarray(cache.k_pages)[0]       # (num_pages, S, H, D)
+    table = np.asarray(cache.page_table)
+    for b, length in enumerate([0, 5, 9]):
+        page, slot = divmod(length, S)
+        assert pool[table[b, page], slot, 0, 0] == b + 1.0
+    # nothing else was touched
+    assert (pool != 0).sum() == B * D
+
+
+def test_write_decode_full_slot_drops_instead_of_clobbering():
+    B, H, D, S = 2, 1, 2, 4
+    cache = PagedKVCache.create(1, B, H, 8, D, page_size=S,
+                                lengths=jnp.asarray([8, 3], jnp.int32))
+    live = jnp.ones((1, cache.k_pages.shape[1], S, H, D))
+    cache = PagedKVCache(live, live, cache.page_table, cache.length)
+    val = jnp.full((B, H, 1, D), 7.0)
+    cache = cache.write_decode(0, val, val)
+    pool = np.asarray(cache.k_pages)[0]
+    table = np.asarray(cache.page_table)
+    # slot 0 is at capacity: every one of ITS pages still holds 1.0
+    assert (pool[table[0]] == 1.0).all()
+    # slot 1 wrote at position 3
+    assert pool[table[1, 0], 3, 0, 0] == 7.0
+
+
+def test_ragged_key_mask_per_slot():
+    cache = PagedKVCache.create(1, 2, 1, 8, 2, page_size=4,
+                                lengths=jnp.asarray([2, 5], jnp.int32))
+    assert cache.ragged
+    m = np.asarray(cache.key_mask(extra=1))
+    assert m.shape == (2, 8)
+    np.testing.assert_array_equal(m[0], [1, 1, 1, 0, 0, 0, 0, 0])
+    np.testing.assert_array_equal(m[1], [1, 1, 1, 1, 1, 1, 0, 0])
+
+
+# ---------------------------------------------------------------------------
+# ragged decode parity through the model (the acceptance-criteria test)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("attn_impl", ["pallas_interpret", "xla"])
+def test_ragged_decode_logits_match_full_forward(attn_impl):
+    """Mixed per-slot lengths: one ragged paged decode step must produce
+    the SAME next-token logits as a full uncached forward of each slot's
+    prefix — the kernel in interpret mode on CPU, dtype-aware f32
+    tolerances."""
+    net, cfg = _tiny()
+    rng = np.random.default_rng(0)
+    S, P = 8, 4
+    prefixes = [rng.integers(0, cfg.vocab_size, n).astype(np.int32)
+                for n in (3, 13, 26)]          # mixed lengths, mid-page
+    B = len(prefixes)
+    cache = net.make_cache(B, S * P, paged=True, page_size=S,
+                           lengths=np.zeros(B, np.int32),
+                           attn_impl=attn_impl)
+    # prefill each slot individually through the batch-1 dense path
+    # (exactly what ServingEngine._admit compiles)
+    kp, vp = cache.k_pages, cache.v_pages
+    for b, ids in enumerate(prefixes):
+        row = cache.page_table[b][None]
+        c1 = PagedKVCache(kp, vp, row, jnp.zeros((), jnp.int32))
+        _, c1 = net(mx.nd.array(ids[None, :-1], dtype="int32"), c1)
+        kp, vp = c1.k_pages, c1.v_pages
+    lengths = jnp.asarray([len(p) - 1 for p in prefixes], jnp.int32)
+    ragged = PagedKVCache(kp, vp, cache.page_table, lengths,
+                          attn_impl=attn_impl)
+    # one ragged decode step: each slot feeds its own last token
+    last = np.stack([p[-1] for p in prefixes])[:, None]
+    logits, _ = net(mx.nd.array(last, dtype="int32"), ragged)
+    got = logits.asnumpy()[:, 0, :]
+    for b, ids in enumerate(prefixes):
+        full = net(mx.nd.array(ids[None], dtype="int32")).asnumpy()
+        np.testing.assert_allclose(got[b], full[0, -1], rtol=2e-4,
+                                   atol=2e-5, err_msg=f"slot {b}")
+
+
+def test_engine_greedy_matches_full_recompute():
+    net, cfg = _tiny()
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, cfg.vocab_size, n).tolist()
+               for n in (3, 9, 17, 5)]
+    want = [_greedy_full(net, p, 8) for p in prompts]
+    # fewer slots than requests → slots recycle mid-run; block of 3 →
+    # admissions happen between decode dispatches
+    eng = ServingEngine(net, num_slots=3, max_length=64, page_size=8,
+                        decode_block=3, attn_impl="pallas_interpret")
+    got = eng.generate(prompts, 8)
+    assert got == want
+    assert eng.stats["requests_finished"] == 4
+
+
+def test_engine_eos_and_budget_free_slots_early():
+    net, cfg = _tiny()
+    rng = np.random.default_rng(2)
+    p0 = rng.integers(0, cfg.vocab_size, 4).tolist()
+    free_run = _greedy_full(net, p0, 8)
+    eos = free_run[2]          # force an early stop on the 3rd token
+    eng = ServingEngine(net, num_slots=2, max_length=64, page_size=8,
+                        decode_block=4, attn_impl="xla")
+    r_eos = Request(p0, 8, eos_token_id=eos)
+    r_long = Request(rng.integers(0, cfg.vocab_size, 6).tolist(), 8)
+    done = eng.serve([r_eos, r_long])
+    assert len(done) == 2
+    # eos is emitted, then the request stops — nothing after it
+    assert r_eos.output_tokens == free_run[:3]
+    assert len(r_long.output_tokens) == 8
+    # the freed slot went back to the pool
+    assert eng.scheduler.num_free == 2
+
+
+def test_engine_sampled_reproducible_across_admission_order():
+    """The per-request RNG stream depends only on (seed, token index):
+    shuffled submission order and a different slot count must emit
+    bit-identical tokens per request."""
+    net, cfg = _tiny()
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, cfg.vocab_size, n).tolist()
+               for n in (3, 7, 11, 5)]
+
+    def run(order, slots, block):
+        eng = ServingEngine(net, num_slots=slots, max_length=64,
+                            page_size=8, decode_block=block,
+                            attn_impl="xla")
+        reqs = [Request(prompts[i], 6, do_sample=True, temperature=0.8,
+                        top_k=20, top_p=0.95, seed=100 + i,
+                        request_id=i) for i in order]
+        eng.serve(reqs)
+        return {r.id: r.output_tokens for r in reqs}
+
+    a = run([0, 1, 2, 3], 2, 3)
+    b = run([3, 1, 0, 2], 4, 5)
+    assert a == b
+
+
+def test_engine_mixed_sampling_modes_one_program():
+    """Greedy and sampled requests share one compiled decode program
+    (per-slot knobs are arrays, not compile-time constants)."""
+    net, cfg = _tiny()
+    rng = np.random.default_rng(4)
+    p = rng.integers(0, cfg.vocab_size, 5).tolist()
+    eng = ServingEngine(net, num_slots=2, max_length=64, page_size=8,
+                        decode_block=4, attn_impl="xla")
+    greedy = Request(p, 6, request_id="g")
+    sampled = Request(p, 6, do_sample=True, temperature=0.7, top_k=10,
+                      seed=9, request_id="s")
+    eng.serve([greedy, sampled])
+    assert greedy.output_tokens == _greedy_full(net, p, 6)
+    assert len(sampled.output_tokens) == 6
+    assert all(0 <= t < cfg.vocab_size for t in sampled.output_tokens)
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+
+def test_scheduler_free_admit_release():
+    s = SlotScheduler(2)
+    r = [Request([1], 4, request_id=i) for i in range(4)]
+    for x in r:
+        s.submit(x)
+    admitted = s.admit()
+    assert [(sl, rq.id) for sl, rq in admitted] == [(0, 0), (1, 1)]
+    assert s.num_free == 0 and s.num_queued == 2
+    assert s.admit() == []                     # no free slots
+    assert s.release(0).id == 0
+    assert [(sl, rq.id) for sl, rq in s.admit()] == [(0, 2)]
+    with pytest.raises(mx.MXNetError):
+        s.release(1 + 1)                       # never-admitted slot
+
+
+def test_scheduler_fifo_no_starvation():
+    """A steady stream of later arrivals can never starve the oldest
+    queued request: admission is strict FIFO."""
+    s = SlotScheduler(1)
+    first = Request([1], 4, request_id="first")
+    s.submit(first)
+    (slot0, got), = s.admit()
+    assert got.id == "first"
+    s.submit(Request([1], 4, request_id="late-0"))
+    order = []
+    for i in range(5):
+        s.submit(Request([1], 4, request_id=f"late-{i + 1}"))
+        s.release(slot0)
+        (slot0, nxt), = s.admit()
+        order.append(nxt.id)
+    assert order == [f"late-{i}" for i in range(5)]
+
+
+def test_scheduler_drain():
+    s = SlotScheduler(2)
+    for i in range(3):
+        s.submit(Request([1], 4, request_id=i))
+    assert s.has_work
+    s.admit()
+    s.release(0)
+    s.release(1)
+    s.admit()
+    assert s.num_queued == 0 and s.num_active == 1
+    s.release(0)
+    assert not s.has_work                      # fully drained
+
+
+def test_engine_drains_more_requests_than_slots():
+    net, cfg = _tiny()
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, cfg.vocab_size, 1 + (i % 4)).tolist()
+               for i in range(7)]
+    eng = ServingEngine(net, num_slots=2, max_length=32, page_size=8,
+                        decode_block=2, attn_impl="xla")
+    outs = eng.generate(prompts, 1 + 3)
+    assert len(outs) == 7
+    assert all(len(o) == 4 for o in outs)
+    assert not eng.has_work
+    assert eng.scheduler.num_free == 2
+
+
+def test_engine_rejects_oversized_prompt():
+    net, _ = _tiny()
+    eng = ServingEngine(net, num_slots=1, max_length=16, page_size=8,
+                        attn_impl="xla")
+    with pytest.raises(mx.MXNetError):
+        eng.submit(Request(list(range(17)), 4))
+
+
+def test_engine_respects_capacity_budget():
+    """A request whose budget exceeds the slot's remaining KV capacity
+    is truncated to what fits instead of writing out of bounds."""
+    net, cfg = _tiny()
+    rng = np.random.default_rng(6)
+    p = rng.integers(0, cfg.vocab_size, 12).tolist()
+    eng = ServingEngine(net, num_slots=1, max_length=16, page_size=8,
+                        decode_block=4, attn_impl="xla")
+    (req,) = eng.serve([Request(p, 50)])
+    # 12 prompt tokens, 16-slot capacity: 4 writes + the final sampled
+    # token = 5 generated
+    assert len(req.output_tokens) == 5
+    assert req.output_tokens == _greedy_full(net, p, 5)
+
+
+# ---------------------------------------------------------------------------
+# bounded trace caches (LRU satellite)
+# ---------------------------------------------------------------------------
+
+def test_hybrid_jit_cache_is_bounded_and_counts_retraces():
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(4, flatten=False, in_units=3)
+    net.initialize()
+    net.hybridize()
+    net._jit_cache.maxsize = 4
+    mx.runtime.reset_jit_cache_stats()
+    for t in range(1, 8):                      # 7 shapes through a 4-cache
+        net(mx.nd.array(np.zeros((2, t, 3), np.float32)))
+    stats = mx.runtime.jit_cache_stats()
+    assert len(net._jit_cache) == 4
+    assert stats["retraces"] >= 7
+    assert stats["evictions"] >= 3
+    before = mx.runtime.jit_cache_stats()["retraces"]
+    net(mx.nd.array(np.zeros((2, 7, 3), np.float32)))   # cached: no trace
+    assert mx.runtime.jit_cache_stats()["retraces"] == before
+
+
+def test_generate_cache_is_bounded():
+    net, cfg = _tiny()
+    import os
+    os.environ["MXNET_TPU_GENERATE_CACHE_SIZE"] = "2"
+    try:
+        prompt = np.zeros((1, 3), np.int32)
+        for n in (1, 2, 3):
+            net.generate(mx.nd.array(prompt, dtype="int32"), n)
+        assert len(net._generate_cache) == 2
+    finally:
+        del os.environ["MXNET_TPU_GENERATE_CACHE_SIZE"]
+
+
+def test_prefill_program_cache_is_bounded():
+    net, cfg = _tiny()
+    eng = ServingEngine(net, num_slots=1, max_length=64, page_size=8,
+                        decode_block=1, attn_impl="xla")
+    eng._prefill_programs.maxsize = 2
+    rng = np.random.default_rng(7)
+    for n in (3, 11, 19, 27):                  # four distinct buckets
+        eng.serve([Request(rng.integers(0, cfg.vocab_size, n).tolist(),
+                           2)])
+    assert len(eng._prefill_programs) == 2
+
+
+# ---------------------------------------------------------------------------
+# long soak (slow lane)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_engine_soak_poisson_arrivals():
+    """Longer mixed-traffic soak: staggered arrivals, mixed lengths and
+    sampling modes, every greedy request checked against the oracle."""
+    net, cfg = _tiny()
+    rng = np.random.default_rng(8)
+    eng = ServingEngine(net, num_slots=4, max_length=64, page_size=8,
+                        decode_block=4, attn_impl="pallas_interpret")
+    reqs = []
+    for i in range(12):
+        n = int(rng.integers(1, 30))
+        sample = bool(i % 3 == 0)
+        reqs.append(Request(rng.integers(0, cfg.vocab_size, n).tolist(),
+                            int(rng.integers(1, 12)), do_sample=sample,
+                            temperature=0.9, top_k=25, seed=i,
+                            request_id=i))
+    # staggered submission: a third up front, the rest trickle in while
+    # the engine is mid-decode (admission between compiled dispatches)
+    pending = list(reqs)
+    for r in pending[:4]:
+        eng.submit(r)
+    trickle = pending[4:]
+    done = []
+    while eng.has_work or trickle:
+        if trickle:
+            eng.submit(trickle.pop(0))
+        done.extend(eng.step())
+    assert len(done) == 12
+    for r in reqs:
+        cap = min(r.max_new_tokens, eng.max_length - r.prompt_len + 1)
+        assert len(r.output_tokens) == cap
+        if not r.do_sample:
+            assert r.output_tokens == _greedy_full(net, r.prompt, cap)
