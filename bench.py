@@ -305,6 +305,8 @@ def bench_gpt2_serving():
     from mxnet_tpu.models import GPT2ForCausalLM, gpt2_774m_config
     from mxnet_tpu.serving import Request, ServingEngine
 
+    from mxnet_tpu import telemetry
+
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
     slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8))
@@ -347,6 +349,9 @@ def bench_gpt2_serving():
     warm = [Request(list(range(1, b + 1)), 2, request_id=f"w{b}")
             for b in range(page, max(p_hi + page, page + 1), page)]
     eng.serve(warm)
+    # telemetry reflects the MEASURED run only, not the warmup compiles
+    eng.reset_stats()
+    telemetry.clear_events()
 
     reqs = mk_requests(n_requests, id0=1000)
     gaps = rng.exponential(1.0 / rate, n_requests) if rate > 0 \
@@ -371,8 +376,31 @@ def bench_gpt2_serving():
                        / max(len(r.output_tokens), 1) for r in reqs])
     ttft = np.asarray([r.token_times[0] - r.t_submit for r in reqs])
     toks_per_sec = total_tokens / dt
+
+    # the engine's own telemetry rides in the round's extras: queue
+    # wait, TTFT, and per-token latency percentiles measured IN-PROCESS
+    # (the request-derived tpot/ttft numbers below cross-check them)
+    def _pcts(name):
+        hist = telemetry.get(name).labels(eng._eid)
+        if hist.count == 0:
+            return None
+        return {"p50_ms": round(hist.percentile(50) * 1e3, 2),
+                "p99_ms": round(hist.percentile(99) * 1e3, 2),
+                "count": hist.count}
+
+    telemetry.memory.sample()
+    mem = telemetry.get("memory_live_array_bytes_peak")
+    tele_extras = {
+        "queue_wait": _pcts("serving_admission_wait_seconds"),
+        "ttft": _pcts("serving_ttft_seconds"),
+        "token_latency": _pcts("serving_token_latency_seconds"),
+        "decode_dispatch": _pcts("serving_decode_dispatch_seconds"),
+        "stats": eng.stats,
+        "live_array_bytes_peak": int(mem.value) if mem else None,
+    }
     _emit("gpt2_serving_tokens_per_sec", round(toks_per_sec, 1),
           "tokens/sec", 0.0, extras={
+              "telemetry": tele_extras,
               "requests": n_requests, "slots": slots,
               "decode_block": block, "total_tokens": total_tokens,
               "makespan_s": round(dt, 3),

@@ -51,6 +51,7 @@ def __getattr__(name):
         "image": "mxnet_tpu.image",
         "test_utils": "mxnet_tpu.test_utils",
         "runtime": "mxnet_tpu.runtime",
+        "telemetry": "mxnet_tpu.telemetry",
         "engine": "mxnet_tpu.engine",
         "serving": "mxnet_tpu.serving",
         "context": "mxnet_tpu.device",

@@ -82,23 +82,34 @@ def is_tracing() -> bool:
 # Bucketed/ragged shape churn (BucketingModule batches, serving prefill
 # buckets) retraces hybrid forwards per signature; without a bound the
 # per-block jit caches grow for the life of the process. Every trace cache
-# (HybridBlock._jit_cache, GPT2._generate_cache) is an LRU with a global
-# retrace/eviction counter surfaced through mx.runtime.jit_cache_stats().
+# (HybridBlock._jit_cache, GPT2._generate_cache) is an LRU whose
+# retrace/eviction counts live on telemetry counters;
+# mx.runtime.jit_cache_stats() stays as a dict view over them.
 
-_jit_cache_stats = {"retraces": 0, "evictions": 0}
+from .. import telemetry as _telemetry  # noqa: E402  (stdlib-only import)
+
+_retraces = _telemetry.counter(
+    "jit_cache_retraces_total",
+    "compiled-program builds across all LRU trace caches")
+_evictions = _telemetry.counter(
+    "jit_cache_evictions_total",
+    "entries dropped by the LRU bound of any trace cache")
 
 
 def jit_cache_stats():
     """Process-wide trace-cache counters: {'retraces': compiled-program
     builds across all LRU trace caches, 'evictions': entries dropped by
     the LRU bound}. A retrace rate that keeps climbing in steady state
-    means shape churn is defeating the caches (pad/bucket the inputs)."""
-    return dict(_jit_cache_stats)
+    means shape churn is defeating the caches (pad/bucket the inputs).
+    Compatibility view over the telemetry counters
+    jit_cache_retraces_total / jit_cache_evictions_total."""
+    return {"retraces": int(_retraces.value),
+            "evictions": int(_evictions.value)}
 
 
 def reset_jit_cache_stats():
-    _jit_cache_stats["retraces"] = 0
-    _jit_cache_stats["evictions"] = 0
+    _retraces.reset()
+    _evictions.reset()
 
 
 class LRUTraceCache(OrderedDict):
@@ -119,12 +130,12 @@ class LRUTraceCache(OrderedDict):
 
     def __setitem__(self, key, value):
         if key not in self:
-            _jit_cache_stats["retraces"] += 1
+            _retraces.inc()
         super().__setitem__(key, value)
         self.move_to_end(key)
         while len(self) > self.maxsize:
             self.popitem(last=False)
-            _jit_cache_stats["evictions"] += 1
+            _evictions.inc()
 
 
 def push_state_update(param, new_data):

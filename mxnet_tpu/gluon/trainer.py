@@ -13,10 +13,26 @@ single device it is a no-op.
 """
 from __future__ import annotations
 
+import time
+
+from .. import telemetry
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .. import optimizer as _opt
 from .parameter import Parameter, ParameterDict
+
+# NOTE: the eager trainer dispatches asynchronously — step wall time here
+# is host-side dispatch cost, not device time (the fused TrainStep is the
+# performance path). It is still the signal that catches host-bound
+# regressions: a climbing p99 with a flat device trace means the host is
+# the bottleneck.
+_step_seconds = telemetry.histogram(
+    "trainer_step_seconds", "eager Trainer.step host wall time")
+_steps_total = telemetry.counter(
+    "trainer_steps_total", "eager Trainer.step calls")
+_updates_skipped = telemetry.counter(
+    "trainer_amp_skipped_steps_total",
+    "steps skipped by dynamic loss scaling on gradient overflow")
 
 
 class Trainer:
@@ -81,6 +97,7 @@ class Trainer:
         also unscales by the dynamic loss scale, skips the update on
         overflow, and adjusts the scale (reference amp trainer patching,
         contrib/amp/amp.py)."""
+        t0 = time.perf_counter()
         scaler = getattr(self, "_amp_loss_scaler", None)
         scale = self._scale / batch_size
         if scaler is not None and not getattr(scaler, "_unscaled", False):
@@ -88,15 +105,20 @@ class Trainer:
         if scaler is not None:
             scaler._unscaled = False
         self._optimizer.rescale_grad = scale
-        self.allreduce_grads()
-        if scaler is not None and not scaler.is_noop:
-            overflow = scaler.has_overflow(
-                [p for p in self._params if p.grad_req != "null"])
-            scaler.update_scale(overflow)
-            if overflow:
-                self.zero_grad()  # skip the update, drop the bad grads
-                return
-        self._update(ignore_stale_grad)
+        try:
+            self.allreduce_grads()
+            if scaler is not None and not scaler.is_noop:
+                overflow = scaler.has_overflow(
+                    [p for p in self._params if p.grad_req != "null"])
+                scaler.update_scale(overflow)
+                if overflow:
+                    self.zero_grad()  # skip the update, drop the bad grads
+                    _updates_skipped.inc()
+                    return
+            self._update(ignore_stale_grad)
+        finally:
+            _steps_total.inc()
+            _step_seconds.observe(time.perf_counter() - t0)
 
     def allreduce_grads(self):
         """Parity: Trainer.allreduce_grads. Under a mesh the gradients are

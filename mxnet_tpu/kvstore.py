@@ -29,11 +29,32 @@ calls jax.distributed.initialize.
 from __future__ import annotations
 
 import os
+import time as _time
 import warnings
 
 import numpy as _np
 
+from . import telemetry as _telemetry
 from .base import MXNetError
+
+# out-of-program collective accounting (the in-program XLA collectives
+# are budgeted statically by parallel.comm.comm_report instead — they
+# never surface to the host, so there is nothing to time here)
+_allreduce_bytes = _telemetry.counter(
+    "kvstore_allreduce_bytes_total",
+    "payload bytes through out-of-program kvstore allreduce",
+    labelnames=("store",))
+_allreduce_seconds = _telemetry.histogram(
+    "kvstore_allreduce_seconds",
+    "wall time of one out-of-program kvstore allreduce",
+    labelnames=("store",))
+_bcast_bytes = _telemetry.counter(
+    "kvstore_broadcast_bytes_total",
+    "payload bytes through kvstore root broadcast",
+    labelnames=("store",))
+_pushpull_total = _telemetry.counter(
+    "kvstore_pushpull_total", "kvstore pushpull key-operations",
+    labelnames=("store",))
 
 __all__ = ["KVStore", "create", "init_distributed", "KVStoreBase"]
 
@@ -215,6 +236,7 @@ class KVStore:
                 self._store[k] = merged
                 for oo in _as_list(o):
                     oo._rebind(merged)
+                _pushpull_total.labels(self._type).inc()
             return out
         self.push(key, value, priority)
         return self.pull(key, out=out, priority=priority)
@@ -309,6 +331,7 @@ class _DistSyncKVStore(KVStore):
             return arr
         import jax.numpy as jnp
         from jax.experimental import multihost_utils
+        t0 = _time.perf_counter()
         comp = getattr(self, "_compressor", None)
         if comp is not None and key is not None and arr.size >= 16:
             packed = comp.compress(key, arr)
@@ -318,6 +341,10 @@ class _DistSyncKVStore(KVStore):
             for row in gathered:
                 d = comp.decompress(jnp.asarray(row), arr.shape)
                 total = d if total is None else total + d
+            _allreduce_bytes.labels(self._type).inc(
+                int(packed.size * packed.dtype.itemsize))
+            _allreduce_seconds.labels(self._type).observe(
+                _time.perf_counter() - t0)
             return total.astype(arr.dtype)
         if (not _DistSyncKVStore._BIG_WARNED
                 and arr.size * arr.dtype.itemsize > self._BIG_BYTES):
@@ -330,13 +357,20 @@ class _DistSyncKVStore(KVStore):
                 "collectives reduce gradients on ICI inside the step "
                 "(SURVEY.md §5.8)", stacklevel=3)
         gathered = multihost_utils.process_allgather(_np.asarray(arr))
-        return jnp.asarray(gathered.sum(axis=0))
+        out = jnp.asarray(gathered.sum(axis=0))
+        _allreduce_bytes.labels(self._type).inc(
+            int(arr.size * arr.dtype.itemsize))
+        _allreduce_seconds.labels(self._type).observe(
+            _time.perf_counter() - t0)
+        return out
 
     def _bcast_from_root(self, arr):
         if self._size == 1:
             return arr
         import jax.numpy as jnp
         from jax.experimental import multihost_utils
+        _bcast_bytes.labels(self._type).inc(
+            int(arr.size * arr.dtype.itemsize))
         return jnp.asarray(
             multihost_utils.broadcast_one_to_all(_np.asarray(arr)))
 

@@ -17,7 +17,24 @@ from __future__ import annotations
 
 import re
 
+from .. import telemetry as _telemetry
+
 __all__ = ["collective_summary", "comm_report", "ring_cost_bytes"]
+
+# comm_report publishes its totals so the compiled-step wire budget sits
+# next to the runtime serving/training metrics in one snapshot — a
+# BENCH round can carry both without re-parsing the report text
+_wire_bytes = _telemetry.gauge(
+    "comm_wire_bytes_per_step",
+    "static ring-model wire bytes per link per compiled step")
+_wire_us = _telemetry.gauge(
+    "comm_wire_us_per_step",
+    "static ring-model wire time (us) per compiled step at the priced "
+    "ICI bandwidth")
+_collective_count = _telemetry.gauge(
+    "comm_collectives_per_step",
+    "collective ops in the last analyzed compiled step",
+    labelnames=("kind",))
 
 _DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8,
                 "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
@@ -133,18 +150,28 @@ def comm_report(step, sig=None, ici_gbps=100.0):
             n_dev *= ax
     rows = collective_summary(text)
     if not rows:
+        _wire_bytes.set(0)
+        _wire_us.set(0)
         return ("no collectives in the program (single-device or fully "
                 "replicated step)")
     lines = [f"{'collective':20s} {'count':>5s} {'payload':>12s} "
              f"{'wire/link':>12s} {'~us @' + str(ici_gbps) + 'GB/s':>14s}"]
     total_us = 0.0
+    total_wire = 0
+    kind_counts = {}
     for r in rows:
         n_ring = r.get("group") or n_dev
         wire = ring_cost_bytes(r["kind"], r["bytes"], n_ring)
         us = wire * r["count"] / (ici_gbps * 1e3)
         total_us += us
+        total_wire += wire * r["count"]
+        kind_counts[r["kind"]] = kind_counts.get(r["kind"], 0) + r["count"]
         lines.append(f"{r['kind']:20s} {r['count']:5d} "
                      f"{r['bytes']:12,} {wire:12,} {us:14.1f}")
     lines.append(f"total wire time ≈ {total_us:.1f} us/step over "
                  f"{n_dev} devices (ring model, no overlap credit)")
+    _wire_bytes.set(total_wire)
+    _wire_us.set(total_us)
+    for kind, cnt in kind_counts.items():
+        _collective_count.labels(kind).set(cnt)
     return "\n".join(lines)

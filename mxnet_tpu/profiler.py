@@ -28,7 +28,7 @@ from .base import MXNetError
 
 __all__ = ["set_config", "set_state", "state", "pause", "resume", "dumps",
            "dump", "Scope", "scope", "Task", "Event", "Counter",
-           "server_trace_dir"]
+           "record_counter", "server_trace_dir"]
 
 _lock = threading.Lock()
 _config = {
@@ -39,7 +39,8 @@ _config = {
     "profile_imperative": True,
 }
 _state = {"running": False, "paused": False, "jax_trace": False}
-_agg = {}  # op name -> [count, total_s, min_s, max_s]
+_agg = {}       # op name -> [count, total_s, min_s, max_s]
+_counters = {}  # profiler.Counter values — their OWN table, never _agg
 
 
 def set_config(**kwargs):
@@ -68,6 +69,7 @@ def set_state(state_name="stop"):
         _state["running"], _state["paused"] = True, False
         with _lock:
             _agg.clear()
+            _counters.clear()
         if _config["trace_dir"]:
             jax.profiler.start_trace(_config["trace_dir"])
             _state["jax_trace"] = True
@@ -107,17 +109,30 @@ def record_op(name, seconds):
             ent[3] = max(ent[3], seconds)
 
 
+def record_counter(name, value):
+    """profiler.Counter values — kept out of the per-op TIME table (they
+    are not durations) in their own section of dumps()."""
+    with _lock:
+        _counters[name] = value
+
+
 def dumps(reset=False, format="table"):
     """The aggregate per-op stats table (parity:
-    MXAggregateProfileStatsPrint / profiler.dumps)."""
+    MXAggregateProfileStatsPrint / profiler.dumps), plus a Counters
+    section when profiler.Counter objects recorded values."""
     with _lock:
         items = sorted(_agg.items(), key=lambda kv: -kv[1][1])
+        counters = dict(_counters)
         if reset:
             _agg.clear()
+            _counters.clear()
     if format == "json":
-        return json.dumps({k: {"count": c, "total_ms": t * 1e3,
-                               "min_ms": mn * 1e3, "max_ms": mx * 1e3}
-                           for k, (c, t, mn, mx) in items})
+        out = {k: {"count": c, "total_ms": t * 1e3,
+                   "min_ms": mn * 1e3, "max_ms": mx * 1e3}
+               for k, (c, t, mn, mx) in items}
+        if counters:
+            out["_counters"] = counters
+        return json.dumps(out)
     header = (f"{'Name':<40}{'Total Count':>12}{'Time (ms)':>14}"
               f"{'Min (ms)':>12}{'Max (ms)':>12}{'Avg (ms)':>12}")
     lines = ["Profile Statistics:", header, "-" * len(header)]
@@ -125,6 +140,10 @@ def dumps(reset=False, format="table"):
         lines.append(f"{name[:39]:<40}{c:>12}{t * 1e3:>14.3f}"
                      f"{mn * 1e3:>12.3f}{mx * 1e3:>12.3f}"
                      f"{t / c * 1e3:>12.3f}")
+    if counters:
+        lines.append("Counters:")
+        for name, v in sorted(counters.items()):
+            lines.append(f"{name[:39]:<40}{v:>12}")
     return "\n".join(lines)
 
 
@@ -159,14 +178,20 @@ class Scope:
         self._t0 = None
 
     def __enter__(self):
-        self._ann = jax.profiler.TraceAnnotation(self._name)
-        self._ann.__enter__()
+        # construct the jax annotation only while the profiler is live:
+        # an inactive profiler must cost nothing per scope (previously
+        # every scope paid annotation construction even when stopped)
+        if is_active():
+            self._ann = jax.profiler.TraceAnnotation(self._name)
+            self._ann.__enter__()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
         dt = time.perf_counter() - self._t0
-        self._ann.__exit__(*exc)
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+            self._ann = None
         if is_active():
             record_op(f"scope::{self._name}", dt)
         return False
@@ -193,8 +218,10 @@ class Event(Task):
 
 
 class Counter:
-    """Parity: profiler.Counter — named monotonic counter recorded into
-    the aggregate table."""
+    """Parity: profiler.Counter — named counter recorded into its own
+    Counters section of dumps() (previously each set_value() pushed a
+    bogus 0.0-duration row into the per-op TIME table, polluting
+    min/avg stats)."""
 
     def __init__(self, name, domain=None, value=0):
         self._name = name
@@ -203,7 +230,7 @@ class Counter:
     def set_value(self, v):
         self.value = v
         if is_active():
-            record_op(f"counter::{self._name}", 0.0)
+            record_counter(f"counter::{self._name}", v)
 
     def increment(self, delta=1):
         self.set_value(self.value + delta)

@@ -12,8 +12,8 @@ sequences stop costing HBM the moment their slot is freed.
 See docs/SERVING.md for the architecture and slot lifecycle.
 """
 from .sampling import sample_tokens, slot_keys  # noqa: F401
-from .scheduler import Request, SlotScheduler  # noqa: F401
+from .scheduler import Request, SlotScheduler, QueueFullError  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
 
-__all__ = ["Request", "SlotScheduler", "ServingEngine", "sample_tokens",
-           "slot_keys"]
+__all__ = ["Request", "SlotScheduler", "QueueFullError", "ServingEngine",
+           "sample_tokens", "slot_keys"]

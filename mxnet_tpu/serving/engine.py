@@ -25,6 +25,7 @@ in a bounded LRU (gluon.block.LRUTraceCache).
 """
 from __future__ import annotations
 
+import itertools
 import time
 
 import numpy as np
@@ -33,14 +34,63 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import telemetry
 from ..base import MXNetError
 from ..gluon.block import LRUTraceCache, _trace_channel
 from ..models.kv_cache import PagedKVCache
 from ..ndarray.ndarray import NDArray
+from ..telemetry import span
 from .sampling import sample_tokens, slot_keys
 from .scheduler import Request, SlotScheduler
 
 __all__ = ["ServingEngine"]
+
+_engine_ids = itertools.count()
+
+# Engine metrics live as per-engine labeled children (engine=<ordinal>)
+# of process-global instruments: `ServingEngine.stats` reads this
+# engine's children, the registry/prometheus view aggregates across
+# engines. docs/OBSERVABILITY.md catalogs each one.
+_E = ("engine",)
+
+
+def _engine_metrics(eid):
+    c, g, h = telemetry.counter, telemetry.gauge, telemetry.histogram
+    m = {
+        "prefills": c("serving_prefill_total",
+                      "prefill dispatches (one per admitted request)", _E),
+        "decode_dispatches": c("serving_decode_dispatch_total",
+                               "compiled K-step decode blocks run", _E),
+        "decode_steps": c("serving_decode_steps_total",
+                          "decode steps run (dispatches x K)", _E),
+        "tokens_emitted": c("serving_tokens_emitted_total",
+                            "tokens sampled and handed to requests", _E),
+        "requests_finished": c("serving_requests_finished_total",
+                               "requests completed (eos or budget)", _E),
+        "requests_rejected": c(
+            "serving_requests_rejected_total",
+            "submissions refused (queue full / prompt too long)", _E),
+        "queue_depth": g("serving_queue_depth",
+                         "requests waiting for a slot", _E),
+        "slot_occupancy": g("serving_slot_occupancy",
+                            "slots decoding right now", _E),
+        "num_slots": g("serving_slots", "configured decode slots", _E),
+        "admission_wait": h("serving_admission_wait_seconds",
+                            "submit -> slot admission wait", _E),
+        "ttft": h("serving_ttft_seconds",
+                  "submit -> first token (queue wait + prefill)", _E),
+        "token_latency": h(
+            "serving_token_latency_seconds",
+            "per-token decode latency at decode-block resolution "
+            "(dispatch wall / K, weighted by tokens emitted)", _E),
+        "prefill_seconds": h("serving_prefill_seconds",
+                             "prefill dispatch wall time", _E),
+        "decode_seconds": h("serving_decode_dispatch_seconds",
+                            "K-step decode block wall time", _E),
+        "drain_seconds": h("serving_drain_seconds",
+                           "serve(): last submit -> queue+slots empty", _E),
+    }
+    return {k: inst.labels(eid) for k, inst in m.items()}
 
 
 class ServingEngine:
@@ -53,12 +103,20 @@ class ServingEngine:
     page_size: KV page granularity. decode_block: decode steps fused
     into one dispatch. attn_impl: 'auto' (ragged Pallas kernel on TPU,
     dense XLA elsewhere), 'pallas', 'pallas_interpret' (the kernel in
-    interpret mode — CPU tests), or 'xla'.
+    interpret mode — CPU tests), or 'xla'. max_queue bounds the
+    admission queue (None = unbounded); a full queue rejects submit()
+    with QueueFullError and counts serving_requests_rejected_total.
+
+    Every engine reports into mx.telemetry as per-engine labeled
+    children (docs/OBSERVABILITY.md): TTFT, admission wait, per-token
+    decode latency, queue depth, slot occupancy, dispatch counts/wall
+    times. `stats` is a dict view of this engine's children;
+    `reset_stats()` zeroes them.
     """
 
     def __init__(self, model, num_slots, max_length=None, page_size=64,
                  decode_block=8, attn_impl="auto", prefill_bucket=None,
-                 dtype=None):
+                 dtype=None, max_queue=None):
         self.model = model
         cfg = model.config
         self.num_slots = int(num_slots)
@@ -77,7 +135,7 @@ class ServingEngine:
             raise MXNetError("decode_block must be >= 1")
         self.attn_impl = attn_impl
         self.prefill_bucket = int(prefill_bucket or page_size)
-        self.scheduler = SlotScheduler(num_slots)
+        self.scheduler = SlotScheduler(num_slots, max_queue=max_queue)
 
         self._params = list(model.collect_params().values())
         B = self.num_slots
@@ -105,21 +163,58 @@ class ServingEngine:
         self._prefill_programs = LRUTraceCache(
             max(2 * (max_length // self.prefill_bucket), 8))
         self._decode_program = None
-        self.stats = {"prefills": 0, "decode_dispatches": 0,
-                      "decode_steps": 0, "tokens_emitted": 0,
-                      "requests_finished": 0}
+        self._eid = str(next(_engine_ids))
+        self._metrics = _engine_metrics(self._eid)
+        self._metrics["num_slots"].set(self.num_slots)
+
+    # -- telemetry ---------------------------------------------------------
+    @property
+    def stats(self):
+        """This engine's counters/gauges as a plain dict (a live read of
+        the telemetry children — the PR-1 bare-dict keys kept intact)."""
+        m = self._metrics
+        return {
+            "prefills": int(m["prefills"].value),
+            "decode_dispatches": int(m["decode_dispatches"].value),
+            "decode_steps": int(m["decode_steps"].value),
+            "tokens_emitted": int(m["tokens_emitted"].value),
+            "requests_finished": int(m["requests_finished"].value),
+            "requests_rejected": int(m["requests_rejected"].value),
+            "queue_depth": int(m["queue_depth"].value),
+            "slot_occupancy": int(m["slot_occupancy"].value),
+        }
+
+    def reset_stats(self):
+        """Zero this engine's telemetry children (other engines and the
+        rest of the registry are untouched)."""
+        for inst in self._metrics.values():
+            inst.reset()
+        self._metrics["num_slots"].set(self.num_slots)
+
+    def _set_load_gauges(self):
+        self._metrics["queue_depth"].set(self.scheduler.num_queued)
+        self._metrics["slot_occupancy"].set(self.scheduler.num_active)
 
     # -- public API --------------------------------------------------------
     def submit(self, request):
-        """Queue a Request (validated against this engine's capacity)."""
+        """Queue a Request (validated against this engine's capacity).
+        Rejections — over-long prompt, full admission queue — count into
+        serving_requests_rejected_total before raising."""
         if request.prompt_len > self.max_length:
+            self._metrics["requests_rejected"].inc()
             raise MXNetError(
                 f"prompt of {request.prompt_len} tokens exceeds slot "
                 f"capacity {self.max_length}")
         request.t_submit = time.perf_counter()
         request.output_tokens = []
         request.token_times = []
-        return self.scheduler.submit(request)
+        try:
+            out = self.scheduler.submit(request)
+        except MXNetError:
+            self._metrics["requests_rejected"].inc()
+            raise
+        self._metrics["queue_depth"].set(self.scheduler.num_queued)
+        return out
 
     @property
     def has_work(self):
@@ -134,18 +229,25 @@ class ServingEngine:
             fin = self._admit(slot, req)
             if fin is not None:
                 finished.append(fin)
+        self._set_load_gauges()
         if self.scheduler.num_active:
             finished.extend(self._decode_block())
+            self._set_load_gauges()
         return finished
 
     def serve(self, requests=()):
         """Submit `requests`, run until the queue and all slots drain,
-        and return every finished request (submission order)."""
+        and return every finished request (submission order). Drain wall
+        time (last submit -> empty) lands in serving_drain_seconds."""
         for r in requests:
             self.submit(r)
+        t_drain0 = time.perf_counter()
         done = []
-        while self.has_work:
-            done.extend(self.step())
+        with span("serving.drain", engine=self._eid):
+            while self.has_work:
+                done.extend(self.step())
+        self._metrics["drain_seconds"].observe(
+            time.perf_counter() - t_drain0)
         done.sort(key=lambda r: r.t_submit)
         return done
 
@@ -205,21 +307,27 @@ class ServingEngine:
             self._prefill_programs[Tb] = fn
         param_datas = tuple(p.data()._data for p in self._params)
         i32 = lambda v: jnp.asarray(v, jnp.int32)  # noqa: E731
-        kp, vp, first, done0 = fn(
-            param_datas, self._kp, self._vp, jnp.asarray(ids), i32(slot),
-            i32(Tp), i32(req.seed), jnp.asarray(req.temperature,
-                                                jnp.float32),
-            i32(req.top_k), jnp.asarray(req.top_p, jnp.float32),
-            jnp.asarray(req.do_sample), i32(
-                -1 if req.eos_token_id is None else req.eos_token_id))
-        self._kp, self._vp = kp, vp
-        first = int(first)
+        t0 = time.perf_counter()
+        with span("serving.prefill", engine=self._eid, bucket=Tb):
+            kp, vp, first, done0 = fn(
+                param_datas, self._kp, self._vp, jnp.asarray(ids),
+                i32(slot), i32(Tp), i32(req.seed),
+                jnp.asarray(req.temperature, jnp.float32),
+                i32(req.top_k), jnp.asarray(req.top_p, jnp.float32),
+                jnp.asarray(req.do_sample), i32(
+                    -1 if req.eos_token_id is None else req.eos_token_id))
+            self._kp, self._vp = kp, vp
+            first = int(first)      # host sync: the prefill is done here
         now = time.perf_counter()
         req.t_admit = now
         req.output_tokens.append(first)
         req.token_times.append(now)
-        self.stats["prefills"] += 1
-        self.stats["tokens_emitted"] += 1
+        m = self._metrics
+        m["prefills"].inc()
+        m["tokens_emitted"].inc()
+        m["admission_wait"].observe(t0 - req.t_submit)
+        m["ttft"].observe(now - req.t_submit)
+        m["prefill_seconds"].observe(now - t0)
         # budget: every decode step writes one KV; the last sampled token
         # is never written, so a prompt of Tp supports up to
         # max_length - Tp + 1 generated tokens
@@ -296,34 +404,47 @@ class ServingEngine:
         if self._decode_program is None:
             self._decode_program = self._build_decode()
         param_datas = tuple(p.data()._data for p in self._params)
-        out = self._decode_program(
-            param_datas, self._kp, self._vp, jnp.asarray(self._lengths),
-            jnp.asarray(self._cur_tok), jnp.asarray(self._done),
-            jnp.asarray(self._remaining), jnp.asarray(self._counters),
-            jnp.asarray(self._seeds), jnp.asarray(self._temp),
-            jnp.asarray(self._top_k), jnp.asarray(self._top_p),
-            jnp.asarray(self._do_sample), jnp.asarray(self._eos))
-        (self._kp, self._vp, lengths, cur_tok, done, remaining, counters,
-         toks, valid) = out
-        # ONE host sync per K decoded tokens: everything small fetches
-        # together (the pools stay on device, donated through)
-        (self._lengths, self._cur_tok, self._done, self._remaining,
-         self._counters) = (
-            np.array(lengths), np.array(cur_tok), np.array(done),
-            np.array(remaining), np.array(counters))
-        toks, valid = np.asarray(toks), np.asarray(valid)
+        t0 = time.perf_counter()
+        with span("serving.decode_block", engine=self._eid,
+                  active=self.scheduler.num_active):
+            out = self._decode_program(
+                param_datas, self._kp, self._vp,
+                jnp.asarray(self._lengths),
+                jnp.asarray(self._cur_tok), jnp.asarray(self._done),
+                jnp.asarray(self._remaining), jnp.asarray(self._counters),
+                jnp.asarray(self._seeds), jnp.asarray(self._temp),
+                jnp.asarray(self._top_k), jnp.asarray(self._top_p),
+                jnp.asarray(self._do_sample), jnp.asarray(self._eos))
+            (self._kp, self._vp, lengths, cur_tok, done, remaining,
+             counters, toks, valid) = out
+            # ONE host sync per K decoded tokens: everything small fetches
+            # together (the pools stay on device, donated through)
+            (self._lengths, self._cur_tok, self._done, self._remaining,
+             self._counters) = (
+                np.array(lengths), np.array(cur_tok), np.array(done),
+                np.array(remaining), np.array(counters))
+            toks, valid = np.asarray(toks), np.asarray(valid)
         now = time.perf_counter()
-        self.stats["decode_dispatches"] += 1
-        self.stats["decode_steps"] += self.decode_block
+        dt = now - t0
+        m = self._metrics
+        m["decode_dispatches"].inc()
+        m["decode_steps"].inc(self.decode_block)
+        m["decode_seconds"].observe(dt)
         finished = []
+        n_emitted = 0
         for slot in self.scheduler.active_slots:
             req = self.scheduler.request_at(slot)
             emitted = toks[valid[:, slot], slot]
             req.output_tokens.extend(int(t) for t in emitted)
             req.token_times.extend([now] * emitted.size)
-            self.stats["tokens_emitted"] += int(emitted.size)
+            n_emitted += int(emitted.size)
             if self._done[slot] or self._remaining[slot] <= 0:
                 finished.append(self._finish(slot))
+        m["tokens_emitted"].inc(n_emitted)
+        # block resolution (same convention as the bench): each of the
+        # block's tokens cost dt/K of dispatch wall time
+        if n_emitted:
+            m["token_latency"].observe(dt / self.decode_block, n_emitted)
         return finished
 
     def _finish(self, slot):
@@ -332,5 +453,5 @@ class ServingEngine:
         # freed slots stay inactive (and write nothing) until re-admitted
         self._done[slot] = True
         self._remaining[slot] = 0
-        self.stats["requests_finished"] += 1
+        self._metrics["requests_finished"].inc()
         return req

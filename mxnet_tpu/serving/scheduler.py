@@ -18,7 +18,7 @@ import numpy as np
 
 from ..base import MXNetError
 
-__all__ = ["Request", "SlotScheduler"]
+__all__ = ["Request", "SlotScheduler", "QueueFullError"]
 
 _req_counter = itertools.count()
 
@@ -69,19 +69,37 @@ class Request:
                 f"generated={len(self.output_tokens)})")
 
 
-class SlotScheduler:
-    """Fixed-pool slot allocator + FIFO admission queue."""
+class QueueFullError(MXNetError):
+    """Raised by SlotScheduler.submit when the bounded admission queue is
+    at capacity — the engine counts these as rejected submissions
+    (serving_requests_rejected_total) before re-raising."""
 
-    def __init__(self, num_slots):
+
+class SlotScheduler:
+    """Fixed-pool slot allocator + FIFO admission queue.
+
+    max_queue bounds the admission queue (None = unbounded): a serving
+    front-end needs backpressure it can see — an unbounded queue turns
+    overload into silent tail-latency collapse instead of a countable
+    rejection."""
+
+    def __init__(self, num_slots, max_queue=None):
         if num_slots < 1:
             raise MXNetError("need at least one decode slot")
         self.num_slots = int(num_slots)
+        self.max_queue = None if max_queue is None else int(max_queue)
+        if self.max_queue is not None and self.max_queue < 1:
+            raise MXNetError("max_queue must be >= 1 (or None)")
         self._free = deque(range(self.num_slots))
         self._queue = deque()
         self._active = {}          # slot -> Request
 
     # -- queue -------------------------------------------------------------
     def submit(self, request):
+        if self.max_queue is not None and len(self._queue) >= self.max_queue:
+            raise QueueFullError(
+                f"admission queue full ({self.max_queue} waiting); "
+                "rejecting request — retry after the queue drains")
         self._queue.append(request)
         return request
 

@@ -1,0 +1,95 @@
+"""mx.telemetry — the framework-wide metrics + tracing subsystem.
+
+Unified observability for serving and training (docs/OBSERVABILITY.md):
+a process-global registry of named Counter/Gauge/Histogram instruments
+(exponential-bucket histograms for latencies, prometheus-style labeled
+children), `span(name)` tracing that nests, logs JSONL, and lines up
+with the XLA device trace, and on-demand device-memory watermark
+sampling.
+
+Instrumented call sites:
+  * serving/engine.py + serving/scheduler.py — queue depth, admission
+    wait, TTFT, per-token decode latency, slot occupancy,
+    prefill/decode dispatch counts + wall time, drain time, rejected
+    submissions;
+  * gluon/trainer.py — eager step wall time and count;
+  * kvstore.py — out-of-program allreduce/broadcast bytes + wall time;
+  * parallel/comm.py — the static per-step collective wire budget of a
+    compiled program (comm_report publishes gauges);
+  * gluon/block.py — jit trace-cache retrace/eviction counters
+    (mx.runtime.jit_cache_stats() is now a view over these).
+
+Zero dependencies: importing this package touches only the stdlib —
+never jax — so it is safe anywhere, including backend-free processes.
+
+Quick use:
+    import mxnet_tpu as mx
+    mx.telemetry.snapshot()                    # nested dict
+    print(mx.telemetry.render_prometheus())    # text exposition
+    mx.telemetry.dump("telemetry.json")
+    with mx.telemetry.span("my.phase"):
+        ...
+    mx.telemetry.reset()                       # tests / bench rounds
+"""
+from __future__ import annotations
+
+from .instruments import (  # noqa: F401
+    Counter, Gauge, Histogram, Registry,
+    DEFAULT_LATENCY_BUCKETS, exponential_buckets,
+)
+from .tracing import (  # noqa: F401
+    span, events, clear_events, enable_jsonl, disable_jsonl,
+)
+from . import memory  # noqa: F401
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry",
+           "DEFAULT_LATENCY_BUCKETS", "exponential_buckets",
+           "default_registry", "counter", "gauge", "histogram", "get",
+           "snapshot", "render_prometheus", "dump", "reset",
+           "span", "events", "clear_events", "enable_jsonl",
+           "disable_jsonl", "memory"]
+
+#: The process-global registry every framework instrument lives in.
+default_registry = Registry()
+
+
+def counter(name, help="", labelnames=()):
+    """Get-or-create a Counter in the default registry."""
+    return default_registry.counter(name, help, labelnames)
+
+
+def gauge(name, help="", labelnames=()):
+    """Get-or-create a Gauge in the default registry."""
+    return default_registry.gauge(name, help, labelnames)
+
+
+def histogram(name, help="", labelnames=(), buckets=None):
+    """Get-or-create a Histogram in the default registry."""
+    return default_registry.histogram(name, help, labelnames, buckets)
+
+
+def get(name):
+    """Look up an instrument by name (None when absent)."""
+    return default_registry.get(name)
+
+
+def snapshot():
+    """Nested dict of every instrument's current state."""
+    return default_registry.snapshot()
+
+
+def render_prometheus():
+    """Prometheus text exposition of the default registry."""
+    return default_registry.render_prometheus()
+
+
+def dump(path):
+    """Write snapshot() as JSON to `path`; returns the path."""
+    return default_registry.dump(path)
+
+
+def reset():
+    """Zero every instrument in place and clear the span ring buffer
+    (instrument/child identities survive — safe with live engines)."""
+    default_registry.reset()
+    clear_events()

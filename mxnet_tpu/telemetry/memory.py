@@ -1,0 +1,88 @@
+"""Device-memory watermark sampling.
+
+Two complementary sources, both read on demand (a collect hook, never a
+hot path):
+
+  * `device.memory_stats()` — the PjRt allocator's own counters
+    (bytes_in_use, peak_bytes_in_use, bytes_limit) where the backend
+    reports them (TPU does; XLA:CPU usually returns {}).
+  * `jax.live_arrays()` — the framework-side view: every live
+    jax.Array's committed bytes. Works on every backend, catches leaks
+    the allocator hides (e.g. host-side buffer pileups), and its
+    process-lifetime maximum is tracked as the
+    `memory_live_array_bytes_peak` watermark.
+
+`install()` registers sampling as a registry collect hook so every
+snapshot()/render_prometheus() carries fresh values; `sample()` takes
+one reading immediately and returns it.
+"""
+from __future__ import annotations
+
+__all__ = ["sample", "install"]
+
+_installed = False
+_live_peak = 0.0
+
+
+def _gauges(registry):
+    g = registry.gauge
+    return {
+        "in_use": g("memory_device_bytes_in_use",
+                    "PjRt allocator bytes in use", labelnames=("device",)),
+        "peak": g("memory_device_peak_bytes",
+                  "PjRt allocator peak bytes in use",
+                  labelnames=("device",)),
+        "limit": g("memory_device_bytes_limit",
+                   "PjRt allocator capacity", labelnames=("device",)),
+        "live_bytes": g("memory_live_array_bytes",
+                        "total bytes of live jax.Arrays"),
+        "live_count": g("memory_live_array_count",
+                        "number of live jax.Arrays"),
+        "live_peak": g("memory_live_array_bytes_peak",
+                       "process-lifetime max of live jax.Array bytes"),
+    }
+
+
+def sample(registry=None):
+    """One reading: update the memory gauges, return them as a dict."""
+    global _live_peak
+    import jax
+
+    from . import default_registry
+    gs = _gauges(registry or default_registry)
+    out = {}
+    for dev in jax.devices():
+        stats = dict(getattr(dev, "memory_stats", lambda: None)() or {})
+        if not stats:
+            continue
+        label = str(dev.id)
+        for key, stat in (("in_use", "bytes_in_use"),
+                          ("peak", "peak_bytes_in_use"),
+                          ("limit", "bytes_limit")):
+            if stat in stats:
+                gs[key].labels(label).set(stats[stat])
+                out[f"{stat}[{label}]"] = stats[stat]
+    n_bytes = 0
+    n = 0
+    for arr in jax.live_arrays():
+        n += 1
+        try:
+            n_bytes += arr.nbytes
+        except Exception:
+            pass                    # deleted/donated buffers race the walk
+    _live_peak = max(_live_peak, float(n_bytes))
+    gs["live_bytes"].set(n_bytes)
+    gs["live_count"].set(n)
+    gs["live_peak"].set(_live_peak)
+    out.update(live_array_bytes=n_bytes, live_array_count=n,
+               live_array_bytes_peak=_live_peak)
+    return out
+
+
+def install(registry=None):
+    """Sample on every snapshot/render of the registry (idempotent)."""
+    global _installed
+    from . import default_registry
+    reg = registry or default_registry
+    reg.add_collect_hook(lambda: sample(reg))
+    _installed = True

@@ -1,0 +1,131 @@
+"""Lightweight span tracing: nesting, JSONL event log, profiler interplay.
+
+`span(name)` is a context manager that (a) nests via a thread-local
+stack, (b) records its duration into the labeled
+`span_duration_seconds{name=...}` histogram, (c) appends a structured
+event to an in-process ring buffer (and, when `enable_jsonl(path)` is
+armed, to a JSON-lines file), and (d) forwards into
+`jax.profiler.TraceAnnotation` — but ONLY while the mx.profiler device
+trace is running, so spans line up with the XLA timeline without paying
+annotation-construction cost (or importing jax at all) in normal
+operation. That gating mirrors the `sys.modules` probe the op-dispatch
+funnel uses (ops/registry._profiler_active): a process that never starts
+a device trace never constructs an annotation.
+
+Event schema (one JSON object per line):
+    {"name", "ts" (unix seconds at exit), "dur" (seconds), "depth",
+     "parent" (enclosing span name or null), "thread", ...attrs}
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from collections import deque
+
+__all__ = ["span", "events", "clear_events", "enable_jsonl",
+           "disable_jsonl"]
+
+_tls = threading.local()
+_events_lock = threading.Lock()
+_events = deque(maxlen=4096)
+_jsonl = {"fh": None, "path": None}
+
+
+def _span_hist():
+    # late import: instruments ↔ tracing have no cycle, but the default
+    # registry lives in the package __init__ which imports this module
+    from . import histogram
+    return histogram("span_duration_seconds",
+                     "wall time of telemetry.span ranges",
+                     labelnames=("name",))
+
+
+def _device_trace_running():
+    prof = sys.modules.get("mxnet_tpu.profiler")
+    return prof is not None and prof._state.get("jax_trace", False)
+
+
+def _stack():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+class span:
+    """with span("serving.decode_block", slot=3): ..."""
+
+    __slots__ = ("name", "attrs", "_ann", "_t0", "_parent", "_depth")
+
+    def __init__(self, name, **attrs):
+        self.name = name
+        self.attrs = attrs
+        self._ann = None
+
+    def __enter__(self):
+        st = _stack()
+        self._parent = st[-1].name if st else None
+        self._depth = len(st)
+        st.append(self)
+        if _device_trace_running():
+            import jax
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self._t0
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+            self._ann = None
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        _span_hist().labels(self.name).observe(dur)
+        ev = {"name": self.name, "ts": time.time(), "dur": dur,
+              "depth": self._depth, "parent": self._parent,
+              "thread": threading.get_ident()}
+        if self.attrs:
+            ev.update(self.attrs)
+        with _events_lock:
+            _events.append(ev)
+            fh = _jsonl["fh"]
+            if fh is not None:
+                try:
+                    fh.write(json.dumps(ev) + "\n")
+                    fh.flush()
+                except Exception:
+                    pass           # a full disk must not break serving
+        return False
+
+
+def events():
+    """The in-process span ring buffer (most recent 4096), oldest first."""
+    with _events_lock:
+        return list(_events)
+
+
+def clear_events():
+    with _events_lock:
+        _events.clear()
+
+
+def enable_jsonl(path):
+    """Start appending every finished span to `path` as JSON lines."""
+    with _events_lock:
+        if _jsonl["fh"] is not None:
+            _jsonl["fh"].close()
+        _jsonl["fh"] = open(path, "a")
+        _jsonl["path"] = path
+    return path
+
+
+def disable_jsonl():
+    with _events_lock:
+        if _jsonl["fh"] is not None:
+            _jsonl["fh"].close()
+        _jsonl["fh"] = None
+        _jsonl["path"] = None

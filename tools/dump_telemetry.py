@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Run a tiny serving and/or training loop and print the telemetry
+snapshot — the smoke-test CLI for the observability subsystem
+(docs/OBSERVABILITY.md).
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/dump_telemetry.py            # both
+    python tools/dump_telemetry.py --workload serving
+    python tools/dump_telemetry.py --workload training
+    python tools/dump_telemetry.py --format prometheus
+    python tools/dump_telemetry.py --out telemetry.json
+    python tools/dump_telemetry.py --spans spans.jsonl
+
+Exit code 0 means the loops ran and the snapshot round-tripped.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_serving():
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import GPT2Config, GPT2ForCausalLM
+    from mxnet_tpu.serving import Request, ServingEngine
+
+    cfg = GPT2Config(vocab_size=97, units=32, num_layers=2, num_heads=2,
+                     max_length=64, dropout=0.0, attention_dropout=0.0)
+    net = GPT2ForCausalLM(cfg)
+    mx.rng.seed(0)
+    net.initialize(mx.init.Normal(0.05))
+    eng = ServingEngine(net, num_slots=2, max_length=32, page_size=8,
+                        decode_block=2, attn_impl="xla")
+    rng = np.random.default_rng(0)
+    reqs = [Request(rng.integers(0, cfg.vocab_size, n).tolist(), 5,
+                    seed=i, do_sample=bool(i % 2))
+            for i, n in enumerate((3, 7, 12, 5))]
+    done = eng.serve(reqs)
+    assert len(done) == len(reqs)
+
+
+def run_training():
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.gluon import Trainer, loss as gloss, nn
+
+    net = nn.Dense(4, flatten=False, in_units=8)
+    net.initialize(mx.init.Normal(0.1))
+    trainer = Trainer(net.collect_params(), opt.SGD(learning_rate=0.1))
+    lfn = gloss.L2Loss()
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        x = mx.nd.array(rng.standard_normal((4, 8)), dtype="float32")
+        y = mx.nd.array(rng.standard_normal((4, 4)), dtype="float32")
+        with mx.autograd.record():
+            loss = lfn(net(x), y)
+        loss.backward()
+        trainer.step(batch_size=4)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workload", choices=("serving", "training", "both"),
+                    default="both")
+    ap.add_argument("--format", choices=("json", "prometheus"),
+                    default="json")
+    ap.add_argument("--out", default=None,
+                    help="also dump the JSON snapshot to this path")
+    ap.add_argument("--spans", default=None,
+                    help="append span events to this JSONL file")
+    args = ap.parse_args()
+
+    from mxnet_tpu import telemetry
+
+    if args.spans:
+        telemetry.enable_jsonl(args.spans)
+    with telemetry.span("dump_telemetry.workloads"):
+        if args.workload in ("serving", "both"):
+            run_serving()
+        if args.workload in ("training", "both"):
+            run_training()
+    telemetry.memory.sample()
+
+    if args.format == "prometheus":
+        print(telemetry.render_prometheus())
+    else:
+        print(json.dumps(telemetry.snapshot(), indent=1, sort_keys=True))
+    if args.out:
+        telemetry.dump(args.out)
+    if args.spans:
+        telemetry.disable_jsonl()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
