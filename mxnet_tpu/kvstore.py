@@ -74,8 +74,19 @@ def init_distributed(coordinator=None, num_processes=None, process_id=None):
     import jax
 
     # NOTE: jax.process_count()/devices() must NOT be called before
-    # jax.distributed.initialize — they would initialize the backend
-    if jax.distributed.is_initialized():
+    # jax.distributed.initialize — they would initialize the backend.
+    # jax.distributed.is_initialized() only exists from jax 0.5; on
+    # older versions the service handle lives in the private global
+    # state object, so probe both.
+    if hasattr(jax.distributed, "is_initialized"):
+        initialized = jax.distributed.is_initialized()
+    else:
+        try:
+            from jax._src.distributed import global_state
+            initialized = global_state.client is not None
+        except Exception:
+            initialized = False
+    if initialized:
         return jax.process_index(), jax.process_count()
     if coordinator is None:
         uri = os.environ.get("DMLC_PS_ROOT_URI")

@@ -105,11 +105,17 @@ class PagedKVCache:
     or a (B,) vector (ragged serving decode, one live length per slot)."""
 
     def __init__(self, k_pages, v_pages, page_table, length,
-                 attn_impl="auto"):
+                 page_lock=None, attn_impl="auto"):
         self.k_pages = k_pages
         self.v_pages = v_pages
         self.page_table = page_table
         self.length = length
+        # optional (num_pages,) bool: True = page is SHARED/cached
+        # (refcount > 1 or owned by the prefix cache) — write_decode
+        # must never land in it (the CoW invariant; the host performs
+        # the actual copy-on-write split, this mask is the in-program
+        # guarantee that a stray write drops instead of corrupting)
+        self.page_lock = page_lock
         self.attn_impl = attn_impl
 
     @classmethod
@@ -132,6 +138,16 @@ class PagedKVCache:
             if num_pages < batch * per_seq:
                 raise MXNetError(
                     f"{num_pages} pages < {batch}x{per_seq} required")
+        else:
+            # a table referencing pages outside the pool would silently
+            # gather garbage (jnp.take clips) — fail loudly instead
+            import numpy as np
+            tbl = np.asarray(page_table)
+            if tbl.size and (tbl.min() < 0 or tbl.max() >= num_pages):
+                raise MXNetError(
+                    f"page_table references pages outside the pool: "
+                    f"entries span [{int(tbl.min())}, {int(tbl.max())}] "
+                    f"but only pages [0, {num_pages}) exist")
         shape = (num_layers, num_pages, page_size, num_heads, head_dim)
         length = jnp.zeros((), jnp.int32) if lengths is None \
             else jnp.asarray(lengths, jnp.int32)
@@ -171,6 +187,7 @@ class PagedKVCache:
         vp = self.v_pages.at[layer, pages, slot].set(
             v_t.astype(self.v_pages.dtype))
         new = PagedKVCache(kp, vp, self.page_table, self.length,
+                           page_lock=self.page_lock,
                            attn_impl=self.attn_impl)
         return new._gather(kp, layer), new._gather(vp, layer), new
 
@@ -181,7 +198,10 @@ class PagedKVCache:
         directly; materializing the dense view is exactly the HBM cost
         this path removes). Slots already at capacity scatter out of
         bounds and the write DROPS (mode='drop') instead of clobbering a
-        live page."""
+        live page; so does any write aimed at a page the page_lock mask
+        marks as shared — the copy-on-write invariant: a page with
+        refcount > 1 (or owned by the prefix cache) is read-only, and
+        the host must CoW-split it before a slot may write there."""
         B = k_new.shape[0]
         S = self.page_size
         P = self.page_table.shape[1]
@@ -193,6 +213,11 @@ class PagedKVCache:
         num_pages = self.k_pages.shape[1]
         # full slots get an out-of-range pool page → scatter drops
         pages = jnp.where(page_idx < P, safe, num_pages)
+        if self.page_lock is not None:
+            locked = jnp.take(self.page_lock,
+                              jnp.minimum(pages, num_pages - 1)) \
+                & (pages < num_pages)
+            pages = jnp.where(locked, num_pages, pages)
         k_t = k_new[:, :, 0, :]                       # (B, H, D)
         v_t = v_new[:, :, 0, :]
         kp = self.k_pages.at[layer, pages, slot].set(
@@ -200,12 +225,21 @@ class PagedKVCache:
         vp = self.v_pages.at[layer, pages, slot].set(
             v_t.astype(self.v_pages.dtype), mode="drop")
         return PagedKVCache(kp, vp, self.page_table, self.length,
+                            page_lock=self.page_lock,
                             attn_impl=self.attn_impl)
 
     def write_prompt(self, layer, k, v):
-        """Prefill write of a whole (B, H, T, D) prompt starting at
-        position 0 (requires length==0 at call time; T is padded up to
-        whole pages)."""
+        """Prefill write of a whole (B, H, T, D) chunk starting at
+        position `length`, which must be PAGE-ALIGNED (length %
+        page_size == 0) — the serving engine's suffix prefill lands a
+        prompt's uncached tail right after its prefix-cache pages this
+        way. length==0 (the classic whole-prompt prefill) is the
+        aligned special case. T is padded up to whole pages; lockstep
+        (scalar-length) caches only."""
+        if self.ragged:
+            raise MXNetError("write_prompt needs a lockstep cache "
+                             "(scalar length); ragged slots prefill "
+                             "individually (serving.ServingEngine)")
         B, H, T, D = k.shape
         S = self.page_size
         n_pages = (T + S - 1) // S
@@ -215,16 +249,21 @@ class PagedKVCache:
         # (B, H, nP*S, D) → (B, nP, S, H, D) — the pool's page layout
         kq = kq.transpose(0, 2, 1, 3).reshape(B, n_pages, S, H, D)
         vq = vq.transpose(0, 2, 1, 3).reshape(B, n_pages, S, H, D)
-        tbl = self.page_table[:, :n_pages]            # (B, nP)
+        start_page = jnp.asarray(self.length, jnp.int32) // S
+        tbl = lax.dynamic_slice(
+            self.page_table, (jnp.zeros((), jnp.int32), start_page),
+            (B, n_pages))                             # (B, nP) at offset
         kp = self.k_pages.at[layer, tbl].set(kq.astype(self.k_pages.dtype))
         vp = self.v_pages.at[layer, tbl].set(vq.astype(self.v_pages.dtype))
         new = PagedKVCache(kp, vp, self.page_table, self.length,
+                           page_lock=self.page_lock,
                            attn_impl=self.attn_impl)
         return new._gather(kp, layer), new._gather(vp, layer), new
 
     def advance(self, n):
         return PagedKVCache(self.k_pages, self.v_pages, self.page_table,
-                            self.length + n, attn_impl=self.attn_impl)
+                            self.length + n, page_lock=self.page_lock,
+                            attn_impl=self.attn_impl)
 
     def key_mask(self, extra=0):
         """Validity over key positions: (T_max,) in lockstep mode,
@@ -236,7 +275,7 @@ class PagedKVCache:
 
     def tree_flatten(self):
         return (self.k_pages, self.v_pages, self.page_table,
-                self.length), self.attn_impl
+                self.length, self.page_lock), self.attn_impl
 
     @classmethod
     def tree_unflatten(cls, aux, children):

@@ -15,7 +15,8 @@ from .sp import ring_attention, sp_enabled, ulysses_attention  # noqa: F401
 from .comm import (collective_summary, comm_report,  # noqa: F401
                    ring_cost_bytes)
 from .pp import (PPTrainStep, gpipe, pipeline_grads,  # noqa: F401
-                 pipeline_loss, stack_stage_params)
+                 pipeline_loss, pipeline_loss_and_grads,
+                 stack_stage_params)
 from .moe import (  # noqa: F401
     all_to_all_tokens, moe_dispatch_combine, top_k_gating)
 from .step import EvalStep, TrainStep  # noqa: F401

@@ -26,7 +26,7 @@ from ..base import MXNetError
 from .mesh import AXIS_PP, PartitionSpec, current_mesh, shard_map_compat
 
 __all__ = ["gpipe", "stack_stage_params", "pipeline_loss",
-           "pipeline_grads", "PPTrainStep"]
+           "pipeline_loss_and_grads", "pipeline_grads", "PPTrainStep"]
 
 
 def stack_stage_params(stage_param_trees):
@@ -159,17 +159,22 @@ def pipeline_loss(embed_fn, stage_fn, head_loss_fn, embed_params,
 
         def step(carry, t):
             state, loss_acc = carry
-            h_in = lax.cond(stage == 0,
-                            lambda: embed_fn(eparams, xs[t % M]),
-                            lambda: state)
+            # jnp.where, not lax.cond: this scan is differentiated (the
+            # gpipe schedule relies on XLA autodiff), and shard_map's
+            # transpose of lax.cond is broken both ways on current jax
+            # (check_rep=False hits a _SpecError, check_rep=True a
+            # branch-replication mismatch). select transposes cleanly;
+            # the cost is that every stage runs embed/head each step —
+            # acceptable for the simple schedule (1f1b is the perf path)
+            h_in = jnp.where(stage == 0, embed_fn(eparams, xs[t % M]),
+                             state)
             out = stage_fn(params, h_in)
             take = (stage == P - 1) & (t >= P - 1)
-            mb_loss = lax.cond(
+            mb_loss = jnp.where(
                 take,
-                lambda: head_loss_fn(
-                    hparams, out,
-                    ys[(t - (P - 1)) % M]).astype(jnp.float32),
-                lambda: jnp.zeros((), jnp.float32))
+                head_loss_fn(hparams, out,
+                             ys[(t - (P - 1)) % M]).astype(jnp.float32),
+                jnp.zeros((), jnp.float32))
             state = lax.ppermute(out, axis, perm)
             return (state, loss_acc + mb_loss), None
 
@@ -189,6 +194,113 @@ def pipeline_loss(embed_fn, stage_fn, head_loss_fn, embed_params,
         in_specs=(PartitionSpec(), PartitionSpec(axis), PartitionSpec(),
                   bspec, bspec),
         out_specs=PartitionSpec(), check_rep=False)
+    return fn(embed_params, stacked_params, head_params, x, y)
+
+
+def pipeline_loss_and_grads(embed_fn, stage_fn, head_loss_fn,
+                            embed_params, stacked_params, head_params,
+                            x, y, n_microbatches, mesh=None,
+                            axis=AXIS_PP):
+    """GPipe-schedule training step: (mean_loss, embed_grads,
+    stacked_body_grads, head_grads) via XLA autodiff of the forward
+    pipeline — the reverse pipeline falls out of the scan's transpose.
+
+    Autodiff runs INSIDE the shard_map region, not through it: current
+    jax cannot transpose a shard_map with check_rep=False (the rewrite
+    machinery raises _SpecError on the residual specs) and
+    check_rep=True rejects the pipeline's per-stage control flow, so
+    each shard takes value_and_grad of the (replicated, psum'd) loss
+    w.r.t. its LOCAL parameter copies — collectives transpose globally
+    (ppermute reverses, psum broadcasts) — and the per-shard partials
+    of the replicated embed/head params are psum-reduced back to the
+    shared total. Same return convention as pipeline_grads: body grads
+    stay sharded over "pp", embed/head grads replicated.
+    """
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        raise MXNetError(f"pipeline needs a mesh with a {axis!r} axis")
+    P = mesh.shape[axis]
+    n_dp = mesh.shape["dp"] if "dp" in mesh.axis_names else 1
+    B = x.shape[0]
+    M = int(n_microbatches)
+    if B % max(n_dp, 1):
+        raise MXNetError(f"batch {B} not divisible over dp={n_dp}")
+    if (B // max(n_dp, 1)) % M:
+        raise MXNetError(
+            f"per-dp-shard batch {B // max(n_dp, 1)} not divisible into "
+            f"{M} microbatches")
+
+    def local(eparams, params, hparams, xs, ys):
+        stage = lax.axis_index(axis)
+        perm = [(i, (i + 1) % P) for i in range(P)]
+        xs_mb = _mb_split(xs, M)
+        ys_mb = _mb_split(ys, M)
+
+        def loss_local(e, p_stacked, h):
+            # this function's return is each shard's SHARE of the mean
+            # loss (nonzero on the last stage only) — deliberately NOT
+            # psum-replicated: under check_rep=False, psum transposes
+            # back to psum, which would inflate every gradient by the
+            # axis size. Keeping collectives out of the differentiated
+            # scalar means value_and_grad computes the exact partials
+            # of Σ_shards(share) = the true mean loss.
+            p = jax.tree_util.tree_map(lambda a: a[0], p_stacked)
+            state0 = jnp.zeros_like(embed_fn(e, xs_mb[0]))
+
+            def step(carry, t):
+                state, loss_acc = carry
+                # jnp.where, not lax.cond: select transposes cleanly
+                # under the in-region autodiff (cond does not)
+                h_in = jnp.where(stage == 0, embed_fn(e, xs_mb[t % M]),
+                                 state)
+                out = stage_fn(p, h_in)
+                take = (stage == P - 1) & (t >= P - 1)
+                mb_loss = jnp.where(
+                    take,
+                    head_loss_fn(h, out,
+                                 ys_mb[(t - (P - 1)) % M]
+                                 ).astype(jnp.float32),
+                    jnp.zeros((), jnp.float32))
+                state = lax.ppermute(out, axis, perm)
+                return (state, loss_acc + mb_loss), None
+
+            (_, loss_sum), _ = lax.scan(
+                step, (state0, jnp.zeros((), jnp.float32)),
+                jnp.arange(M + P - 1))
+            share = loss_sum / M
+            if dp:
+                share = share / n_dp
+            return share
+
+        share, (ge, gb, gh) = jax.value_and_grad(
+            loss_local, argnums=(0, 1, 2))(eparams, params, hparams)
+        # replicate the loss value and the shared-parameter grads OUTSIDE
+        # the differentiated function: the true grad of a replicated
+        # parameter is the sum of the per-shard partials (the 1/n_dp
+        # scaling already lives inside the loss, so dp also sums)
+        loss = lax.psum(share, axis)
+        if dp:
+            loss = lax.psum(loss, dp)
+
+        def repl(g):
+            g = lax.psum(g, axis)
+            return lax.psum(g, dp) if dp else g
+
+        ge = jax.tree_util.tree_map(repl, ge)
+        gh = jax.tree_util.tree_map(repl, gh)
+        if dp:  # body params are replicated across dp: sum the partials
+            gb = jax.tree_util.tree_map(lambda g: lax.psum(g, dp), gb)
+        return loss, ge, gb, gh
+
+    dp = "dp" if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 else None
+    bspec = PartitionSpec(dp) if dp else PartitionSpec()
+    fn = shard_map_compat(
+        local, mesh=mesh,
+        in_specs=(PartitionSpec(), PartitionSpec(axis), PartitionSpec(),
+                  bspec, bspec),
+        out_specs=(PartitionSpec(), PartitionSpec(),
+                   PartitionSpec(axis), PartitionSpec()),
+        check_rep=False)
     return fn(embed_params, stacked_params, head_params, x, y)
 
 
@@ -412,13 +524,12 @@ class PPTrainStep:
                     embed_fn, stage_fn, head_loss_fn, eparams, bparams,
                     hparams, x, y, M, mesh=mesh)
             else:
-                def loss_of(e, b, h):
-                    return pipeline_loss(embed_fn, stage_fn,
-                                         head_loss_fn, e, b, h, x, y, M,
-                                         mesh=mesh)
-                loss, (ge, gb, gh) = jax.value_and_grad(
-                    loss_of, argnums=(0, 1, 2))(eparams, bparams,
-                                                hparams)
+                # gpipe: autodiff INSIDE the shard_map region (jax
+                # cannot transpose through it — see
+                # pipeline_loss_and_grads)
+                loss, ge, gb, gh = pipeline_loss_and_grads(
+                    embed_fn, stage_fn, head_loss_fn, eparams, bparams,
+                    hparams, x, y, M, mesh=mesh)
             for e_key, h_key in tied:
                 ge[e_key] = ge[e_key] + gh[h_key].astype(ge[e_key].dtype)
             gh = {k: v for k, v in gh.items() if k not in tied_h}

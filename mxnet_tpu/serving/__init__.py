@@ -9,11 +9,20 @@ decodes against its own live length through the ragged paged-attention
 kernel (ops/pallas_attention.ragged_decode_attention), so finished
 sequences stop costing HBM the moment their slot is freed.
 
+Page ownership is explicit: serving/page_pool.py is a host-side
+ref-counted allocator over the PagedKVCache page axis, and
+serving/prefix_cache.py is a radix tree over token-id prefixes whose
+nodes own full KV pages — ServingEngine(prefix_cache=True) attaches a
+new request's cached prompt prefix by page-table surgery and prefills
+only the uncached suffix (O(prompt) → O(suffix)).
+
 See docs/SERVING.md for the architecture and slot lifecycle.
 """
 from .sampling import sample_tokens, slot_keys  # noqa: F401
 from .scheduler import Request, SlotScheduler, QueueFullError  # noqa: F401
+from .page_pool import PagePool  # noqa: F401
+from .prefix_cache import PrefixCache  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
 
 __all__ = ["Request", "SlotScheduler", "QueueFullError", "ServingEngine",
-           "sample_tokens", "slot_keys"]
+           "PagePool", "PrefixCache", "sample_tokens", "slot_keys"]

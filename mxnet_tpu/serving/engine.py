@@ -6,16 +6,28 @@ Execution model (docs/SERVING.md):
     its own live length; the decode forward runs all B slots through the
     ragged paged-attention kernel, so per-token HBM traffic is the sum
     of LIVE lengths, not B × max_length.
-  * PREFILL is one compiled program per prompt-length bucket: it writes
-    the prompt's KV into the slot's pages (batch-1, attention only over
-    the bucket) and samples the request's first token.
+  * PAGE OWNERSHIP is explicit: a host-side ref-counted allocator
+    (serving/page_pool.py) hands each admitted request its pages, and a
+    radix-tree prefix cache (serving/prefix_cache.py) lets requests
+    SHARE the pages of a common prompt prefix — admission does a
+    longest-prefix match, maps the cached pages into the slot's table
+    by page-table surgery, and prefills only the uncached suffix.
+    Shared pages are read-only through the page table (the decode
+    kernel is unchanged); the in-program page_lock mask plus a host
+    copy-on-write split for fully-cached prompts guarantee no write
+    ever lands in a shared page.
+  * PREFILL is one compiled program per SUFFIX-length bucket: it writes
+    the suffix's KV into the slot's pages at the prefix offset
+    (attention reads the cached prefix through the same table) and
+    samples the request's first token.
   * DECODE runs K steps per host dispatch via lax.scan — the
     TrainStep.run_steps pattern applied to serving. PERF_NOTES measured
     ~24 ms/step of host dispatch tax over a remote tunnel; at one
     token per step that tax would dominate decode, so the block size K
     amortizes it K-fold.
-  * Between dispatches the host frees finished slots and admits queued
-    requests (FIFO) — continuous batching: nobody waits for the slowest
+  * Between dispatches the host frees finished slots (releasing page
+    leases back to the pool/prefix cache) and admits queued requests
+    (FIFO) — continuous batching: nobody waits for the slowest
     sequence in a fixed batch.
 
 Everything per-request (sampling knobs, seeds, eos, budgets) is a
@@ -40,6 +52,8 @@ from ..gluon.block import LRUTraceCache, _trace_channel
 from ..models.kv_cache import PagedKVCache
 from ..ndarray.ndarray import NDArray
 from ..telemetry import span
+from .page_pool import PagePool
+from .prefix_cache import PrefixCache
 from .sampling import sample_tokens, slot_keys
 from .scheduler import Request, SlotScheduler
 
@@ -59,6 +73,10 @@ def _engine_metrics(eid):
     m = {
         "prefills": c("serving_prefill_total",
                       "prefill dispatches (one per admitted request)", _E),
+        "prefill_tokens": c(
+            "serving_prefill_tokens_total",
+            "prompt tokens actually computed by prefill (the uncached "
+            "suffix only when the prefix cache hits)", _E),
         "decode_dispatches": c("serving_decode_dispatch_total",
                                "compiled K-step decode blocks run", _E),
         "decode_steps": c("serving_decode_steps_total",
@@ -70,11 +88,34 @@ def _engine_metrics(eid):
         "requests_rejected": c(
             "serving_requests_rejected_total",
             "submissions refused (queue full / prompt too long)", _E),
+        "requests_cancelled": c(
+            "serving_requests_cancelled_total",
+            "requests aborted via cancel() (queued or running)", _E),
+        "prefix_hits": c(
+            "serving_prefix_cache_hits_total",
+            "admissions whose prompt matched >= 1 cached page", _E),
+        "prefix_misses": c(
+            "serving_prefix_cache_misses_total",
+            "admissions with no cached prefix", _E),
+        "prefix_tokens_saved": c(
+            "serving_prefix_tokens_saved_total",
+            "prompt tokens skipped at prefill (attached from cache)", _E),
+        "prefix_evicted_pages": c(
+            "serving_prefix_cache_evicted_pages_total",
+            "cached pages reclaimed by the LRU-by-leaf policy", _E),
         "queue_depth": g("serving_queue_depth",
                          "requests waiting for a slot", _E),
         "slot_occupancy": g("serving_slot_occupancy",
                             "slots decoding right now", _E),
         "num_slots": g("serving_slots", "configured decode slots", _E),
+        "prefix_cache_pages": g(
+            "serving_prefix_cache_pages",
+            "KV pages held by the prefix-cache radix tree", _E),
+        "prefix_pages_shared": g(
+            "serving_prefix_pages_shared",
+            "pool pages currently mapped by more than one lease", _E),
+        "pool_free_pages": g("serving_page_pool_free",
+                             "unallocated pages in the KV page pool", _E),
         "admission_wait": h("serving_admission_wait_seconds",
                             "submit -> slot admission wait", _E),
         "ttft": h("serving_ttft_seconds",
@@ -107,16 +148,25 @@ class ServingEngine:
     admission queue (None = unbounded); a full queue rejects submit()
     with QueueFullError and counts serving_requests_rejected_total.
 
+    prefix_cache=True turns on radix-tree prompt reuse: admission
+    longest-prefix-matches each prompt against previously served ones
+    and attaches the shared KV pages instead of recomputing them.
+    prefix_cache_pages sizes BOTH the extra physical pages added to the
+    pool for retained prefixes and the tree's eviction budget (default:
+    one full slot-set, num_slots * pages_per_slot). Sampled output is
+    bit-identical with the cache on or off.
+
     Every engine reports into mx.telemetry as per-engine labeled
     children (docs/OBSERVABILITY.md): TTFT, admission wait, per-token
     decode latency, queue depth, slot occupancy, dispatch counts/wall
-    times. `stats` is a dict view of this engine's children;
-    `reset_stats()` zeroes them.
+    times, prefix-cache hits/misses/tokens-saved/evictions. `stats` is
+    a dict view of this engine's children; `reset_stats()` zeroes them.
     """
 
     def __init__(self, model, num_slots, max_length=None, page_size=64,
                  decode_block=8, attn_impl="auto", prefill_bucket=None,
-                 dtype=None, max_queue=None):
+                 dtype=None, max_queue=None, prefix_cache=False,
+                 prefix_cache_pages=None):
         self.model = model
         cfg = model.config
         self.num_slots = int(num_slots)
@@ -139,16 +189,37 @@ class ServingEngine:
 
         self._params = list(model.collect_params().values())
         B = self.num_slots
-        P = max_length // page_size
+        P = self._pages_per_slot = max_length // page_size
+        # pool sizing: every slot can always claim a full P exclusive
+        # pages (worst case, zero sharing) + `extra` pages so the prefix
+        # cache can retain prefixes across request lifetimes
+        extra = 0
+        if prefix_cache:
+            extra = B * P if prefix_cache_pages is None \
+                else int(prefix_cache_pages)
+            if extra < 0:
+                raise MXNetError("prefix_cache_pages must be >= 0")
+        total_pages = B * P + extra
         dt = dtype or jnp.dtype(cfg.dtype)
-        pool_shape = (cfg.num_layers, B * P, page_size, cfg.num_heads,
-                      cfg.units // cfg.num_heads)
+        pool_shape = (cfg.num_layers, total_pages, page_size,
+                      cfg.num_heads, cfg.units // cfg.num_heads)
         self._kp = jnp.zeros(pool_shape, dt)
         self._vp = jnp.zeros(pool_shape, dt)
-        self._table = jnp.arange(B * P, dtype=jnp.int32).reshape(B, P)
+        self.page_pool = PagePool(total_pages)
+        self.prefix_cache = PrefixCache(self.page_pool, page_size,
+                                        budget_pages=extra) \
+            if prefix_cache else None
+        # per-slot page tables are HOST state now (page-table surgery at
+        # admission); uploaded with each dispatch
+        self._table_host = np.zeros((B, P), np.int32)
+        self._mapped = np.zeros(B, bool)   # slot holds page leases
         # per-slot host state (tiny; uploaded per dispatch, fetched back
-        # with the decoded tokens — one round trip per K tokens)
-        self._lengths = np.zeros(B, np.int32)
+        # with the decoded tokens — one round trip per K tokens).
+        # Unmapped slots park at length == max_length: their in-program
+        # decode writes fall off the page table and DROP, so a freed
+        # slot can never scribble on pages that were recycled to a new
+        # owner or retained by the prefix cache.
+        self._lengths = np.full(B, self.max_length, np.int32)
         self._cur_tok = np.zeros(B, np.int32)
         self._done = np.ones(B, bool)          # free slots are inactive
         self._remaining = np.zeros(B, np.int32)
@@ -163,9 +234,18 @@ class ServingEngine:
         self._prefill_programs = LRUTraceCache(
             max(2 * (max_length // self.prefill_bucket), 8))
         self._decode_program = None
+
+        def _copy_page(kp, vp, src, dst):
+            # CoW split: clone one physical page's (L, S, H, D) slab
+            return (kp.at[:, dst].set(kp[:, src]),
+                    vp.at[:, dst].set(vp[:, src]))
+
+        self._copy_page_fn = jax.jit(_copy_page, donate_argnums=(0, 1))
         self._eid = str(next(_engine_ids))
         self._metrics = _engine_metrics(self._eid)
         self._metrics["num_slots"].set(self.num_slots)
+        self._evictions_seen = 0
+        self._set_pool_gauges()
 
     # -- telemetry ---------------------------------------------------------
     @property
@@ -175,11 +255,20 @@ class ServingEngine:
         m = self._metrics
         return {
             "prefills": int(m["prefills"].value),
+            "prefill_tokens": int(m["prefill_tokens"].value),
             "decode_dispatches": int(m["decode_dispatches"].value),
             "decode_steps": int(m["decode_steps"].value),
             "tokens_emitted": int(m["tokens_emitted"].value),
             "requests_finished": int(m["requests_finished"].value),
             "requests_rejected": int(m["requests_rejected"].value),
+            "requests_cancelled": int(m["requests_cancelled"].value),
+            "prefix_hits": int(m["prefix_hits"].value),
+            "prefix_misses": int(m["prefix_misses"].value),
+            "prefix_tokens_saved": int(m["prefix_tokens_saved"].value),
+            "prefix_evicted_pages": int(m["prefix_evicted_pages"].value),
+            "prefix_cache_pages": int(m["prefix_cache_pages"].value),
+            "prefix_pages_shared": int(m["prefix_pages_shared"].value),
+            "pool_free_pages": int(m["pool_free_pages"].value),
             "queue_depth": int(m["queue_depth"].value),
             "slot_occupancy": int(m["slot_occupancy"].value),
         }
@@ -190,10 +279,24 @@ class ServingEngine:
         for inst in self._metrics.values():
             inst.reset()
         self._metrics["num_slots"].set(self.num_slots)
+        self._set_pool_gauges()
 
     def _set_load_gauges(self):
         self._metrics["queue_depth"].set(self.scheduler.num_queued)
         self._metrics["slot_occupancy"].set(self.scheduler.num_active)
+
+    def _set_pool_gauges(self):
+        m = self._metrics
+        m["pool_free_pages"].set(self.page_pool.num_free)
+        m["prefix_pages_shared"].set(
+            int(self.page_pool.shared_mask().sum()))
+        pc = self.prefix_cache
+        if pc is not None:
+            m["prefix_cache_pages"].set(pc.num_pages)
+            delta = pc.evicted_pages - self._evictions_seen
+            if delta:
+                m["prefix_evicted_pages"].inc(delta)
+                self._evictions_seen = pc.evicted_pages
 
     # -- public API --------------------------------------------------------
     def submit(self, request):
@@ -215,6 +318,25 @@ class ServingEngine:
             raise
         self._metrics["queue_depth"].set(self.scheduler.num_queued)
         return out
+
+    def cancel(self, request_id):
+        """Abort a request by id, queued OR running. A queued request is
+        simply dequeued; a running one releases its slot and its page
+        leases immediately (tokens already emitted stay on the Request).
+        Returns the cancelled Request, or None when the id is unknown
+        (already finished, never submitted). Call from the serving
+        thread — cancellation mutates slot state between dispatches."""
+        req = self.scheduler.cancel_queued(request_id)
+        if req is None:
+            slot = self.scheduler.slot_of(request_id)
+            if slot is None:
+                return None
+            req = self._release_slot(slot)
+        req.t_finish = time.perf_counter()
+        self._metrics["requests_cancelled"].inc()
+        self._set_load_gauges()
+        self._set_pool_gauges()
+        return req
 
     @property
     def has_work(self):
@@ -259,18 +381,73 @@ class ServingEngine:
         self.serve(reqs)
         return [by_id[r.id].output_tokens for r in reqs]
 
+    # -- pages -------------------------------------------------------------
+    def _page_lock_host(self):
+        """(total_pages,) bool for the decode program: True = this page
+        must not be written (shared, cached, or free). Decode writes are
+        only legal in pages the writing slot holds EXCLUSIVELY."""
+        lock = self.page_pool.refcounts() != 1
+        if self.prefix_cache is not None:
+            lock |= self.prefix_cache.member_mask()
+        return lock
+
+    def _map_slot_pages(self, slot, req):
+        """Page-table surgery for an admission: longest-prefix match,
+        CoW split when the whole prompt is cached, exclusive allocation
+        for the rest. Returns the prefix offset (tokens NOT recomputed;
+        prefill starts there)."""
+        S, P = self.page_size, self._pages_per_slot
+        Tp = req.prompt_len
+        pc = self.prefix_cache
+        matched = pc.match(req.prompt) if pc is not None else []
+        cow_src = None
+        if matched and len(matched) * S >= Tp:
+            # Fully cached prompt (page-aligned): the last token must
+            # still run through the model for its logits, and that
+            # rewrites the KV at position Tp-1 — INSIDE the last cached
+            # page. Copy-on-write: re-home that page to an exclusive
+            # copy; the other matched pages stay shared.
+            cow_src = matched.pop()
+        n_shared = len(matched)
+        need = P - n_shared
+        if pc is not None and self.page_pool.num_free < need:
+            pc.reclaim(need)           # LRU-evict idle cached prefixes
+        fresh = self.page_pool.alloc(need)
+        if cow_src is not None:
+            dst = fresh[0]             # lands at row index n_shared
+            self._kp, self._vp = self._copy_page_fn(
+                self._kp, self._vp, jnp.asarray(cow_src, jnp.int32),
+                jnp.asarray(dst, jnp.int32))
+            pc.release([cow_src])      # drop our lease on the source
+            offset = Tp - 1
+        else:
+            offset = n_shared * S
+        self._table_host[slot] = np.asarray(matched + fresh, np.int32)
+        self._mapped[slot] = True
+        return offset
+
+    def _free_slot_pages(self, slot):
+        if not self._mapped[slot]:
+            return
+        row = [int(p) for p in self._table_host[slot]]
+        if self.prefix_cache is not None:
+            self.prefix_cache.release(row)
+        else:
+            self.page_pool.free(self.page_pool.decref(row))
+        self._mapped[slot] = False
+
     # -- prefill -----------------------------------------------------------
-    def _bucket(self, n):
+    def _bucket(self, n, offset=0):
+        if n == 1:
+            return 1     # CoW / one-token suffixes get their own program
         b = self.prefill_bucket
-        return min(((n + b - 1) // b) * b, self.max_length)
+        return min(((n + b - 1) // b) * b, self.max_length - offset)
 
     def _build_prefill(self, t_bucket):
         model, params = self.model, self._params
-        table = self._table
-        n_pages = t_bucket // self.page_size
 
-        def prefill(param_arrays, kp, vp, ids, slot, true_len, seed,
-                    temp, top_k, top_p, do_sample, eos):
+        def prefill(param_arrays, kp, vp, ids, row, offset, true_len,
+                    seed, temp, top_k, top_p, do_sample, eos):
             saved = [p._data for p in params]
             _trace_channel.push_frame()
             try:
@@ -278,9 +455,11 @@ class ServingEngine:
                     arr = NDArray(d)
                     arr._grad_req = "null"
                     p._data = arr
-                row = jnp.take(table, slot, axis=0)       # (P,)
-                cache = PagedKVCache(kp, vp, row[None, :n_pages],
-                                     jnp.zeros((), jnp.int32),
+                # the slot's FULL table row: attention reads the cached
+                # prefix pages and the freshly written suffix through
+                # one gather; length=offset puts the suffix writes (and
+                # positions) right after the prefix
+                cache = PagedKVCache(kp, vp, row[None, :], offset,
                                      attn_impl=self.attn_impl)
                 logits, cache = model.forward(NDArray(ids), cache)
             finally:
@@ -298,9 +477,11 @@ class ServingEngine:
 
     def _admit(self, slot, req):
         Tp = req.prompt_len
-        Tb = self._bucket(Tp)
+        offset = self._map_slot_pages(slot, req)
+        suffix = Tp - offset
+        Tb = self._bucket(suffix, offset)
         ids = np.zeros((1, Tb), np.int32)
-        ids[0, :Tp] = req.prompt
+        ids[0, :suffix] = req.prompt[offset:]
         fn = self._prefill_programs.get(Tb)
         if fn is None:
             fn = self._build_prefill(Tb)
@@ -308,10 +489,12 @@ class ServingEngine:
         param_datas = tuple(p.data()._data for p in self._params)
         i32 = lambda v: jnp.asarray(v, jnp.int32)  # noqa: E731
         t0 = time.perf_counter()
-        with span("serving.prefill", engine=self._eid, bucket=Tb):
+        with span("serving.prefill", engine=self._eid, bucket=Tb,
+                  cached_tokens=offset):
             kp, vp, first, done0 = fn(
                 param_datas, self._kp, self._vp, jnp.asarray(ids),
-                i32(slot), i32(Tp), i32(req.seed),
+                jnp.asarray(self._table_host[slot]), i32(offset),
+                i32(suffix), i32(req.seed),
                 jnp.asarray(req.temperature, jnp.float32),
                 i32(req.top_k), jnp.asarray(req.top_p, jnp.float32),
                 jnp.asarray(req.do_sample), i32(
@@ -324,10 +507,27 @@ class ServingEngine:
         req.token_times.append(now)
         m = self._metrics
         m["prefills"].inc()
+        m["prefill_tokens"].inc(suffix)
         m["tokens_emitted"].inc()
         m["admission_wait"].observe(t0 - req.t_submit)
         m["ttft"].observe(now - req.t_submit)
         m["prefill_seconds"].observe(now - t0)
+        pc = self.prefix_cache
+        if pc is not None:
+            if offset:
+                m["prefix_hits"].inc()
+                m["prefix_tokens_saved"].inc(offset)
+            else:
+                m["prefix_misses"].inc()
+            # adopt the prompt's full pages into the radix tree: the
+            # next request sharing this prefix attaches instead of
+            # recomputing (prefill is host-synced above, so the page
+            # contents are final)
+            n_full = Tp // self.page_size
+            if n_full:
+                pc.insert(req.prompt,
+                          [int(p) for p in self._table_host[slot][:n_full]])
+            self._set_pool_gauges()
         # budget: every decode step writes one KV; the last sampled token
         # is never written, so a prompt of Tp supports up to
         # max_length - Tp + 1 generated tokens
@@ -351,11 +551,10 @@ class ServingEngine:
     # -- decode ------------------------------------------------------------
     def _build_decode(self):
         model, params = self.model, self._params
-        table, K = self._table, self.decode_block
-        impl = self.attn_impl
+        K, impl = self.decode_block, self.attn_impl
 
-        def decode(param_arrays, kp, vp, lengths, cur_tok, done,
-                   remaining, counters, seeds, temp, top_k, top_p,
+        def decode(param_arrays, kp, vp, table, lock, lengths, cur_tok,
+                   done, remaining, counters, seeds, temp, top_k, top_p,
                    do_sample, eos):
             saved = [p._data for p in params]
             _trace_channel.push_frame()
@@ -370,7 +569,7 @@ class ServingEngine:
                      counters) = carry
                     active = (~done) & (remaining > 0)
                     cache = PagedKVCache(kp, vp, table, lengths,
-                                         attn_impl=impl)
+                                         page_lock=lock, attn_impl=impl)
                     tok_in = jnp.where(active, cur_tok, 0)
                     logits, cache = model.forward(
                         NDArray(tok_in[:, None]), cache)
@@ -409,6 +608,8 @@ class ServingEngine:
                   active=self.scheduler.num_active):
             out = self._decode_program(
                 param_datas, self._kp, self._vp,
+                jnp.asarray(self._table_host),
+                jnp.asarray(self._page_lock_host()),
                 jnp.asarray(self._lengths),
                 jnp.asarray(self._cur_tok), jnp.asarray(self._done),
                 jnp.asarray(self._remaining), jnp.asarray(self._counters),
@@ -447,11 +648,20 @@ class ServingEngine:
             m["token_latency"].observe(dt / self.decode_block, n_emitted)
         return finished
 
-    def _finish(self, slot):
+    def _release_slot(self, slot):
+        """Free a slot mid-flight or at completion: scheduler slot back
+        to the pool, page leases released, in-program writes parked OOB
+        (length = max_length) so the recycled pages can't be touched."""
         req = self.scheduler.release(slot)
         req.t_finish = time.perf_counter()
-        # freed slots stay inactive (and write nothing) until re-admitted
         self._done[slot] = True
         self._remaining[slot] = 0
+        self._lengths[slot] = self.max_length
+        self._free_slot_pages(slot)
+        return req
+
+    def _finish(self, slot):
+        req = self._release_slot(slot)
         self._metrics["requests_finished"].inc()
+        self._set_pool_gauges()
         return req

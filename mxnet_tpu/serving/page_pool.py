@@ -1,0 +1,163 @@
+"""Ref-counted KV page allocator — explicit ownership for the page pool.
+
+PR 1's engine gave every decode slot a fixed, implicit set of physical
+pages (slot ``b`` owned pages ``[b*P, (b+1)*P)`` forever). Prefix reuse
+(serving/prefix_cache.py) breaks that model: a physical page holding a
+cached prompt prefix may be mapped into several slots' page tables at
+once and must outlive all of them, so ownership has to be counted, not
+assumed. ``PagePool`` is that ledger — a host-side allocator over the
+``num_pages`` axis of the device pools in ``PagedKVCache``:
+
+  * ``alloc(n)``      — take n free pages, each born with refcount 1
+                        (the caller's lease).
+  * ``incref(pages)`` — add a lease (a second slot mapping a shared
+                        prefix page, serving/prefix_cache.py match()).
+  * ``decref(pages)`` — drop a lease; returns the pages that hit zero.
+                        Zero-ref pages are NOT auto-freed: the prefix
+                        cache keeps them materialized (and evictable)
+                        until its LRU policy says otherwise.
+  * ``free(pages)``   — return zero-ref pages to the free list.
+  * ``cow(page)``     — copy-on-write split decision: a shared page
+                        about to be written must first be re-homed to a
+                        fresh exclusive page (the engine performs the
+                        device-side copy; the pool only does the
+                        accounting).
+
+The pool never touches device memory — it indexes it. All methods are
+O(pages) numpy/list work on the host, called between compiled
+dispatches. Invariants are enforced loudly (double free, refcount
+underflow, incref of a free page all raise MXNetError): a silent
+accounting bug here becomes silent KV corruption on device.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["PagePool"]
+
+
+class PagePool:
+    """Host-side ref-counted allocator over a pool of physical KV pages."""
+
+    def __init__(self, num_pages):
+        if num_pages < 1:
+            raise MXNetError("PagePool needs at least one page")
+        self.num_pages = int(num_pages)
+        self._refcount = np.zeros(self.num_pages, np.int32)
+        self._allocated = np.zeros(self.num_pages, bool)
+        self._free = deque(range(self.num_pages))
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def num_free(self):
+        return len(self._free)
+
+    @property
+    def num_allocated(self):
+        return self.num_pages - len(self._free)
+
+    def refcount(self, page):
+        return int(self._refcount[page])
+
+    def refcounts(self):
+        """Copy of the (num_pages,) int32 refcount vector."""
+        return self._refcount.copy()
+
+    def shared_mask(self):
+        """(num_pages,) bool: pages with more than one lease."""
+        return self._refcount > 1
+
+    def exclusive_mask(self):
+        """(num_pages,) bool: pages with exactly one lease — the only
+        pages a decode write may legally land in."""
+        return self._refcount == 1
+
+    # -- lifecycle ---------------------------------------------------------
+    def _check(self, pages, allocated):
+        for p in pages:
+            if not 0 <= p < self.num_pages:
+                raise MXNetError(f"page {p} outside pool "
+                                 f"[0, {self.num_pages})")
+            if bool(self._allocated[p]) != allocated:
+                state = "allocated" if allocated else "free"
+                raise MXNetError(f"page {p} is not {state}")
+
+    def alloc(self, n):
+        """Take `n` free pages (refcount 1 each). Raises when the pool
+        cannot satisfy the request — the caller (prefix cache) evicts
+        and retries; the pool itself never reclaims."""
+        if n < 0:
+            raise MXNetError("alloc(n) needs n >= 0")
+        if n > len(self._free):
+            raise MXNetError(
+                f"page pool exhausted: want {n} pages, {len(self._free)} "
+                f"free of {self.num_pages} (evict cached prefixes or "
+                "grow prefix_cache_pages)")
+        pages = [self._free.popleft() for _ in range(n)]
+        self._refcount[pages] = 1
+        self._allocated[pages] = True
+        return pages
+
+    def incref(self, pages):
+        """Add one lease per page (pages must be live)."""
+        pages = list(pages)
+        self._check(pages, allocated=True)
+        for p in pages:
+            if self._refcount[p] < 1:
+                raise MXNetError(f"incref of zero-ref page {p} (only the "
+                                 "prefix cache may resurrect idle pages)")
+        np.add.at(self._refcount, pages, 1)
+        return pages
+
+    def adopt(self, pages):
+        """Add one lease per page where refcount may be 0 (the prefix
+        cache re-leasing an idle cached page on a match)."""
+        pages = list(pages)
+        self._check(pages, allocated=True)
+        np.add.at(self._refcount, pages, 1)
+        return pages
+
+    def decref(self, pages):
+        """Drop one lease per page; returns the pages that reached zero
+        (still allocated — pass them to free() to recycle)."""
+        pages = list(pages)
+        self._check(pages, allocated=True)
+        for p in pages:
+            if self._refcount[p] < 1:
+                raise MXNetError(f"refcount underflow on page {p}")
+        np.subtract.at(self._refcount, pages, 1)
+        return [p for p in pages if self._refcount[p] == 0]
+
+    def free(self, pages):
+        """Return zero-ref pages to the free list."""
+        pages = list(pages)
+        self._check(pages, allocated=True)
+        for p in pages:
+            if self._refcount[p] != 0:
+                raise MXNetError(f"freeing page {p} with live refcount "
+                                 f"{int(self._refcount[p])}")
+        for p in pages:
+            self._allocated[p] = False
+            self._free.append(p)
+        return pages
+
+    def cow(self, page):
+        """Copy-on-write split: given a page the caller wants to WRITE,
+        return (dst_page, needs_copy). Exclusive pages come straight
+        back (write in place). Shared pages cost one fresh page — the
+        caller must copy the slab on device, then holds dst exclusively;
+        the caller's lease on `page` is dropped here."""
+        self._check([page], allocated=True)
+        if self._refcount[page] == 1:
+            return page, False
+        (dst,) = self.alloc(1)
+        self.decref([page])
+        return dst, True
+
+    def __repr__(self):
+        return (f"PagePool(pages={self.num_pages}, free={self.num_free}, "
+                f"shared={int((self._refcount > 1).sum())})")

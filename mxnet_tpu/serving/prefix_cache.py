@@ -1,0 +1,215 @@
+"""Radix-tree prompt prefix cache over ref-counted KV pages.
+
+Production traffic is dominated by shared prompt prefixes — system
+prompts, few-shot templates, multi-turn history (the Gemma-on-TPU
+serving study calls the workload prefill-bound, PAPERS.md). Because
+every attention read in the serving path already goes through a
+per-slot page table (PagedKVCache + the ragged paged-attention kernel),
+a cached prefix can be attached to a new request by *page-table
+surgery* alone: map the shared physical pages into the slot's table,
+set the cache length past them, and prefill only the uncached suffix.
+Zero recompute, zero copy — repeated-prefix prefill cost drops from
+O(prompt) to O(suffix).
+
+Structure: a radix tree at PAGE granularity. Each edge is one full
+page's worth of token ids (``page_size`` tokens, as a tuple key); each
+node owns exactly one physical page in the PagePool holding that
+chunk's K/V for every layer. Partial trailing pages are never cached —
+a node's page is always complete and therefore read-only forever,
+which is what makes sharing safe (see the CoW rule in engine._admit
+for the one exception: a fully-cached prompt whose last token must be
+re-run for logits).
+
+Ownership protocol (see page_pool.py):
+  * ``match(tokens)`` walks the tree and takes one lease per matched
+    page for the caller; the engine maps those pages into the slot.
+  * ``insert(tokens, pages)`` adopts the slot's freshly prefilled full
+    prompt pages as tree nodes — membership, not a lease: when the
+    slot later releases, the page's refcount drops to zero but the
+    page stays materialized in the tree, instantly re-attachable.
+  * ``release(pages)`` drops the slot's leases; zero-ref pages NOT in
+    the tree are freed, zero-ref tree pages become EVICTABLE.
+  * Eviction is LRU-by-leaf: only leaves (no children — an interior
+    node's chunk is a prefix of live entries) with zero leases are
+    candidates, oldest touch first. ``budget_pages`` bounds the
+    tree's page footprint so churn can never OOM the pool.
+"""
+from __future__ import annotations
+
+import itertools
+
+from ..base import MXNetError
+from .page_pool import PagePool
+
+__all__ = ["PrefixCache"]
+
+
+class _Node:
+    __slots__ = ("parent", "key", "page", "children", "stamp")
+
+    def __init__(self, parent=None, key=None, page=None):
+        self.parent = parent
+        self.key = key          # tuple of page_size token ids (edge label)
+        self.page = page        # physical page id in the pool
+        self.children = {}      # chunk tuple -> _Node
+        self.stamp = 0          # LRU touch stamp (monotonic)
+
+
+class PrefixCache:
+    """Radix tree over token-id prefixes; nodes own full KV pages."""
+
+    def __init__(self, pool, page_size, budget_pages=None):
+        if not isinstance(pool, PagePool):
+            raise MXNetError("PrefixCache needs a PagePool")
+        if page_size < 1:
+            raise MXNetError("page_size must be >= 1")
+        self.pool = pool
+        self.page_size = int(page_size)
+        self.budget_pages = None if budget_pages is None \
+            else int(budget_pages)
+        self._root = _Node()
+        self._by_page = {}               # page id -> node
+        self._clock = itertools.count(1)
+        # counters (the engine mirrors these into mx.telemetry)
+        self.hits = 0                    # match() calls returning >= 1 page
+        self.misses = 0
+        self.tokens_matched = 0
+        self.evicted_pages = 0
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def num_pages(self):
+        """Pages currently owned by tree nodes (leased or idle)."""
+        return len(self._by_page)
+
+    def member_mask(self):
+        """(num_pages,) bool over the pool: True for tree-owned pages.
+        The engine ORs this into the decode program's page_lock so a
+        cached page can never be clobbered by a stray write."""
+        import numpy as np
+        mask = np.zeros(self.pool.num_pages, bool)
+        if self._by_page:
+            mask[list(self._by_page)] = True
+        return mask
+
+    def contains(self, tokens):
+        """True when every full page of `tokens` is cached."""
+        node = self._root
+        for chunk in self._chunks(tokens):
+            node = node.children.get(chunk)
+            if node is None:
+                return False
+        return True
+
+    def _chunks(self, tokens):
+        S = self.page_size
+        toks = [int(t) for t in tokens]
+        return [tuple(toks[i:i + S])
+                for i in range(0, len(toks) - len(toks) % S, S)]
+
+    # -- the hot path ------------------------------------------------------
+    def match(self, tokens):
+        """Longest-prefix match at page granularity. Returns the matched
+        physical pages in prefix order, each carrying ONE new lease for
+        the caller (release() them when the slot frees). Touches the
+        matched path's LRU stamps."""
+        stamp = next(self._clock)
+        node, pages = self._root, []
+        for chunk in self._chunks(tokens):
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            child.stamp = stamp
+            pages.append(child.page)
+            node = child
+        if pages:
+            self.pool.adopt(pages)       # lease, even if the page was idle
+            self.hits += 1
+            self.tokens_matched += len(pages) * self.page_size
+        else:
+            self.misses += 1
+        return pages
+
+    def insert(self, tokens, pages):
+        """Adopt the slot's prompt pages into the tree. ``pages`` maps
+        1:1 onto the full-page chunks of ``tokens`` (the slot's table
+        prefix after prefill). Chunks already cached keep their existing
+        node/page — the supplied duplicate page stays slot-owned and is
+        freed at release. Returns the number of pages adopted."""
+        chunks = self._chunks(tokens)
+        if len(pages) < len(chunks):
+            raise MXNetError(f"insert: {len(chunks)} full pages of tokens "
+                             f"but only {len(pages)} pages supplied")
+        stamp = next(self._clock)
+        node, adopted = self._root, 0
+        for chunk, page in zip(chunks, pages):
+            child = node.children.get(chunk)
+            if child is None:
+                if page in self._by_page:
+                    raise MXNetError(f"page {page} already owned by "
+                                     "another tree node")
+                child = _Node(parent=node, key=chunk, page=int(page))
+                node.children[chunk] = child
+                self._by_page[child.page] = child
+                adopted += 1
+            child.stamp = stamp
+            node = child
+        self.enforce_budget()
+        return adopted
+
+    def release(self, pages):
+        """Drop one lease per page (a slot freeing its table). Zero-ref
+        pages outside the tree go back to the free list; zero-ref tree
+        pages stay cached (evictable)."""
+        zeroed = self.pool.decref(pages)
+        stray = [p for p in zeroed if p not in self._by_page]
+        if stray:
+            self.pool.free(stray)
+        self.enforce_budget()
+
+    # -- eviction ----------------------------------------------------------
+    def _evict_one(self):
+        """Free the least-recently-touched idle leaf. Returns True when
+        a page was reclaimed."""
+        best = None
+        for page, node in self._by_page.items():
+            if node.children or self.pool.refcount(page) != 0:
+                continue
+            if best is None or node.stamp < best.stamp:
+                best = node
+        if best is None:
+            return False
+        del best.parent.children[best.key]
+        del self._by_page[best.page]
+        self.pool.free([best.page])
+        self.evicted_pages += 1
+        return True
+
+    def enforce_budget(self):
+        """Evict idle leaves until the tree fits its page budget (leased
+        pages can push past it transiently — they are pinned)."""
+        if self.budget_pages is None:
+            return
+        while len(self._by_page) > self.budget_pages:
+            if not self._evict_one():
+                break
+
+    def reclaim(self, n_free):
+        """Evict idle leaves until the POOL has `n_free` free pages (an
+        admission that needs pages the free list cannot cover). Returns
+        True when the target was reached."""
+        while self.pool.num_free < n_free:
+            if not self._evict_one():
+                return False
+        return True
+
+    def clear(self):
+        """Drop every idle page (leased pages survive — they belong to
+        live slots)."""
+        while self._evict_one():
+            pass
+
+    def __repr__(self):
+        return (f"PrefixCache(pages={self.num_pages}, "
+                f"budget={self.budget_pages}, hits={self.hits}, "
+                f"misses={self.misses}, evicted={self.evicted_pages})")

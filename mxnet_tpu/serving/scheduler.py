@@ -122,6 +122,23 @@ class SlotScheduler:
         self._free.append(slot)
         return req
 
+    def cancel_queued(self, request_id):
+        """Remove a not-yet-admitted request from the queue by id.
+        Returns the Request, or None when no queued request matches
+        (it may already be running — see slot_of)."""
+        for i, req in enumerate(self._queue):
+            if req.id == request_id:
+                del self._queue[i]
+                return req
+        return None
+
+    def slot_of(self, request_id):
+        """Slot currently decoding `request_id`, or None."""
+        for slot, req in self._active.items():
+            if req.id == request_id:
+                return slot
+        return None
+
     # -- introspection -----------------------------------------------------
     def request_at(self, slot):
         return self._active.get(slot)

@@ -34,13 +34,19 @@ def run_serving():
     mx.rng.seed(0)
     net.initialize(mx.init.Normal(0.05))
     eng = ServingEngine(net, num_slots=2, max_length=32, page_size=8,
-                        decode_block=2, attn_impl="xla")
+                        decode_block=2, attn_impl="xla", prefix_cache=True)
     rng = np.random.default_rng(0)
-    reqs = [Request(rng.integers(0, cfg.vocab_size, n).tolist(), 5,
-                    seed=i, do_sample=bool(i % 2))
+    # half the prompts extend one shared prefix so the prefix-cache
+    # instruments carry real values in the dump
+    shared = rng.integers(0, cfg.vocab_size, 9).tolist()
+    reqs = [Request(shared + rng.integers(0, cfg.vocab_size, 3).tolist()
+                    if i % 2 else
+                    rng.integers(0, cfg.vocab_size, n).tolist(), 5,
+                    seed=i, do_sample=bool(i % 2), request_id=i)
             for i, n in enumerate((3, 7, 12, 5))]
     done = eng.serve(reqs)
     assert len(done) == len(reqs)
+    return eng
 
 
 def run_training():
@@ -80,9 +86,10 @@ def main():
 
     if args.spans:
         telemetry.enable_jsonl(args.spans)
+    eng = None
     with telemetry.span("dump_telemetry.workloads"):
         if args.workload in ("serving", "both"):
-            run_serving()
+            eng = run_serving()
         if args.workload in ("training", "both"):
             run_training()
     telemetry.memory.sample()
@@ -91,6 +98,19 @@ def main():
         print(telemetry.render_prometheus())
     else:
         print(json.dumps(telemetry.snapshot(), indent=1, sort_keys=True))
+    if eng is not None:
+        # the prefix-cache headline, precomputed (the raw counters are
+        # all in the snapshot above): hit-rate and page sharing
+        s = eng.stats
+        lookups = s["prefix_hits"] + s["prefix_misses"]
+        rate = s["prefix_hits"] / lookups if lookups else 0.0
+        print(f"# prefix-cache: hit-rate {rate:.2%} "
+              f"({s['prefix_hits']}/{lookups}), "
+              f"tokens saved {s['prefix_tokens_saved']}, "
+              f"pages cached {s['prefix_cache_pages']}, "
+              f"pages shared {s['prefix_pages_shared']}, "
+              f"evicted {s['prefix_evicted_pages']}, "
+              f"pool free {s['pool_free_pages']}")
     if args.out:
         telemetry.dump(args.out)
     if args.spans:
