@@ -133,21 +133,33 @@ class GPT2Attention(HybridBlock):
             # ragged serving decode: each slot appends at its OWN length
             # and attends only its live pages through the ragged paged-
             # attention kernel — no dense (B, T_max) gather at all.
-            if t != 1:
-                raise MXNetError("ragged caches decode one token per "
-                                 "step; prefill slots individually "
-                                 "(serving.ServingEngine)")
-            from ..ops.pallas_attention import ragged_decode_attention
+            # t == 1 is plain decode; t > 1 is a speculative-
+            # verification dispatch (current token + drafts), where
+            # query position j attends < length + j + 1 through the
+            # multi-query kernel's per-position causal offsets.
+            from ..ops.pallas_attention import (ragged_decode_attention,
+                                                ragged_mq_decode_attention)
             cache = cache.write_decode(layer_idx, k._data, v._data)
             impl = cache.attn_impl
-            out = ragged_decode_attention(
-                q._data[:, :, 0, :].astype(cache.k_pages.dtype),
-                cache.k_pages[layer_idx], cache.v_pages[layer_idx],
-                cache.page_table, cache.length + 1,
-                impl="pallas" if impl == "pallas_interpret" else impl,
-                interpret=impl == "pallas_interpret")
-            b, h, d = out.shape
-            out = out.astype(q._data.dtype).reshape(b, 1, h * d)
+            interp = impl == "pallas_interpret"
+            impl = "pallas" if interp else impl
+            if t == 1:
+                out = ragged_decode_attention(
+                    q._data[:, :, 0, :].astype(cache.k_pages.dtype),
+                    cache.k_pages[layer_idx], cache.v_pages[layer_idx],
+                    cache.page_table, cache.length + 1,
+                    impl=impl, interpret=interp)
+                b, h, d = out.shape
+                out = out.astype(q._data.dtype).reshape(b, 1, h * d)
+            else:
+                out = ragged_mq_decode_attention(
+                    q._data.transpose(0, 2, 1, 3).astype(
+                        cache.k_pages.dtype),
+                    cache.k_pages[layer_idx], cache.v_pages[layer_idx],
+                    cache.page_table, cache.length + 1,
+                    impl=impl, interpret=interp)
+                b, tq, h, d = out.shape
+                out = out.astype(q._data.dtype).reshape(b, tq, h * d)
             return self.proj(NDArray(out)), cache
         if t > 1:
             k_all, v_all, cache = cache.write_prompt(

@@ -192,34 +192,41 @@ class PagedKVCache:
         return new._gather(kp, layer), new._gather(vp, layer), new
 
     def write_decode(self, layer, k_new, v_new):
-        """Ragged decode write: each slot appends its token at its OWN
-        length. k_new/v_new (B, H, 1, D). Returns just the updated cache
-        — no gathered views (the ragged attention kernel reads the pools
+        """Ragged decode write: each slot appends its token(s) at its OWN
+        length. k_new/v_new (B, H, t, D) — t = 1 for plain decode, t > 1
+        for a speculative-verification dispatch (slot b's token j lands
+        at position length[b] + j). Returns just the updated cache — no
+        gathered views (the ragged attention kernel reads the pools
         directly; materializing the dense view is exactly the HBM cost
-        this path removes). Slots already at capacity scatter out of
+        this path removes). Positions past capacity scatter out of
         bounds and the write DROPS (mode='drop') instead of clobbering a
         live page; so does any write aimed at a page the page_lock mask
         marks as shared — the copy-on-write invariant: a page with
         refcount > 1 (or owned by the prefix cache) is read-only, and
-        the host must CoW-split it before a slot may write there."""
-        B = k_new.shape[0]
+        the host must CoW-split it before a slot may write there.
+        Rejected speculative drafts rely on the same discipline: their
+        KV stays behind `length`, invisible to attention, and the next
+        accepted write overwrites it in place."""
+        B, _, t, _ = k_new.shape
         S = self.page_size
         P = self.page_table.shape[1]
         length = self.length if self.ragged \
             else jnp.broadcast_to(self.length, (B,))
-        page_idx = length // S                        # (B,)
-        slot = length % S                             # (B,)
-        safe = self.page_table[jnp.arange(B), jnp.minimum(page_idx, P - 1)]
+        pos = length[:, None] + jnp.arange(t)         # (B, t)
+        page_idx = pos // S
+        slot = pos % S
+        safe = jnp.take_along_axis(self.page_table,
+                                   jnp.minimum(page_idx, P - 1), axis=1)
         num_pages = self.k_pages.shape[1]
-        # full slots get an out-of-range pool page → scatter drops
+        # positions past capacity get an out-of-range pool page → drop
         pages = jnp.where(page_idx < P, safe, num_pages)
         if self.page_lock is not None:
             locked = jnp.take(self.page_lock,
                               jnp.minimum(pages, num_pages - 1)) \
                 & (pages < num_pages)
             pages = jnp.where(locked, num_pages, pages)
-        k_t = k_new[:, :, 0, :]                       # (B, H, D)
-        v_t = v_new[:, :, 0, :]
+        k_t = k_new.transpose(0, 2, 1, 3)             # (B, t, H, D)
+        v_t = v_new.transpose(0, 2, 1, 3)
         kp = self.k_pages.at[layer, pages, slot].set(
             k_t.astype(self.k_pages.dtype), mode="drop")
         vp = self.v_pages.at[layer, pages, slot].set(

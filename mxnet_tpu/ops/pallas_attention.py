@@ -553,6 +553,167 @@ def ragged_decode_attention(q, k_pages, v_pages, page_table, lengths,
     return out.reshape(B, H, D)
 
 
+# ---------------------------------------------------------------------------
+# multi-query ragged paged-attention (speculative-decoding verification).
+#
+# The speculative-decoding dispatch feeds S tokens per slot — the current
+# token plus up to S-1 prompt-lookup drafts — and verifies them all in ONE
+# forward pass (docs/SERVING.md "Speculative decoding"). Query position j
+# of slot b sits at absolute position lengths[b]-1+j, so it may attend key
+# positions < lengths[b]+j: the per-position CAUSAL OFFSET. Same grid and
+# DMA-eliding page remap as the single-query kernel above; the (Sq, S)
+# score tile replaces the (1, S) one and the online-softmax accumulators
+# carry one row per query position.
+# ---------------------------------------------------------------------------
+
+def _ragged_mq_decode_kernel(table_ref, len_ref, q_ref, k_ref, v_ref,
+                             o_ref, m_ref, l_ref, acc_ref, *, scale, S,
+                             Sq, H, D):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    length = len_ref[b]
+    # the furthest query (row Sq-1) reaches position length + Sq - 2
+    n_live = (length + Sq - 1 + S - 1) // S
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(p < n_live)
+    def _accumulate():
+        # rows are query positions, columns token positions in this page;
+        # row j's causal window is pos < length + j
+        rows = lax.broadcasted_iota(jnp.int32, (Sq, S), 0)
+        cols = p * S + lax.broadcasted_iota(jnp.int32, (Sq, S), 1)
+        valid = cols < length + rows
+        for h in range(H):
+            c0, c1 = h * D, (h + 1) * D
+            q = q_ref[0, :, c0:c1]                     # (Sq, D)
+            k = k_ref[0, :, c0:c1]                     # (S, D)
+            s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+            s = jnp.where(valid, s, NEG_INF)           # (Sq, S)
+            m_prev = m_ref[h][:, :1]                   # (Sq, 1)
+            l_prev = l_ref[h][:, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            e = jnp.where(m_new <= NEG_INF / 2, 0.0, jnp.exp(s - m_new))
+            alpha = jnp.where(m_new <= NEG_INF / 2, 1.0,
+                              jnp.exp(m_prev - m_new))
+            v = v_ref[0, :, c0:c1]                     # (S, D)
+            pv = lax.dot_general(e.astype(v.dtype), v,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+            acc_ref[h] = acc_ref[h] * alpha + pv
+            l_new = l_prev * alpha + jnp.sum(e, axis=-1, keepdims=True)
+            l_ref[h] = jnp.broadcast_to(l_new, l_ref[h].shape)
+            m_ref[h] = jnp.broadcast_to(m_new, m_ref[h].shape)
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _emit():
+        for h in range(H):
+            c0, c1 = h * D, (h + 1) * D
+            o_ref[0, :, c0:c1] = (
+                acc_ref[h]
+                / jnp.maximum(l_ref[h][:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _ragged_mq_reference(q, k_pages, v_pages, page_table, lengths, scale):
+    """Dense XLA fallback/oracle for the multi-query kernel: full gather,
+    per-position causal-offset mask — query j of slot b attends key
+    positions < lengths[b] + j."""
+    B, Sq = q.shape[0], q.shape[1]
+    g = jnp.take(k_pages, page_table, axis=0)          # (B, P, S, H, D)
+    P, S = g.shape[1], g.shape[2]
+    k = g.reshape(B, P * S, *g.shape[3:])              # (B, T, H, D)
+    v = jnp.take(v_pages, page_table, axis=0).reshape(B, P * S,
+                                                      *g.shape[3:])
+    s = jnp.einsum("bjhd,bthd->bjht", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    limit = lengths[:, None] + jnp.arange(Sq)[None, :]     # (B, Sq)
+    mask = (jnp.arange(P * S)[None, None, :]
+            < limit[:, :, None])[:, :, None, :]            # (B, Sq, 1, T)
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(s - m))
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    w = e / jnp.maximum(l, 1e-30)
+    return jnp.einsum("bjht,bthd->bjhd", w,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def ragged_mq_decode_attention(q, k_pages, v_pages, page_table, lengths,
+                               scale=None, impl="auto", interpret=False):
+    """Multi-query ragged paged-attention for one speculative dispatch.
+
+    q:              (B, Sq, H, D) — Sq query tokens per slot (the current
+                    token plus the drafts), already written to the cache
+                    at positions lengths-1 .. lengths+Sq-2.
+    k_pages/v_pages:(num_pages, S, H, D) — ONE layer's page pools.
+    page_table:     (B, P) int32 — physical pages per slot.
+    lengths:        (B,) int32 — live tokens through query 0 (its own
+                    position included); query j attends key positions
+                    < lengths[b] + j (the per-position causal offset).
+    impl/interpret: same contract as ragged_decode_attention. Sq=1 is
+    the degenerate case and matches the single-query kernel exactly.
+    Returns (B, Sq, H, D) in q's dtype.
+    """
+    B, Sq, H, D = q.shape
+    N, S = k_pages.shape[0], k_pages.shape[1]
+    P = page_table.shape[1]
+    s = float(scale) if scale is not None else 1.0 / math.sqrt(D)
+    if impl == "auto":
+        on_tpu = jax.default_backend() == "tpu" and not interpret
+        impl = "pallas" if (on_tpu and ragged_supported(q[:, 0], k_pages)) \
+            else ("pallas" if interpret else "xla")
+    if impl == "xla":
+        return _ragged_mq_reference(q, k_pages, v_pages, page_table,
+                                    lengths, s)
+    if impl != "pallas":
+        raise ValueError(f"unknown ragged attention impl {impl!r}")
+    qp = q.reshape(B, Sq, H * D)
+    kp = k_pages.reshape(N, S, H * D)
+    vp = v_pages.reshape(N, S, H * D)
+    lengths = lengths.astype(jnp.int32)
+    table = page_table.astype(jnp.int32)
+
+    def page_index(b, p, tbl, lens):
+        # same DMA-eliding remap as the single-query kernel, with the
+        # live extent stretched to cover the furthest query position
+        last_live = jnp.maximum((lens[b] + Sq - 1 + S - 1) // S - 1, 0)
+        return (tbl[b, jnp.minimum(p, last_live)], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, P),
+        in_specs=[
+            pl.BlockSpec((1, Sq, H * D), lambda b, p, tbl, lens: (b, 0, 0)),
+            pl.BlockSpec((1, S, H * D), page_index),
+            pl.BlockSpec((1, S, H * D), page_index),
+        ],
+        out_specs=pl.BlockSpec((1, Sq, H * D),
+                               lambda b, p, tbl, lens: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, Sq, 128), jnp.float32),   # running max
+            pltpu.VMEM((H, Sq, 128), jnp.float32),   # running denominator
+            pltpu.VMEM((H, Sq, D), jnp.float32),     # running numerator
+        ],
+    )
+    kernel = functools.partial(_ragged_mq_decode_kernel, scale=s, S=S,
+                               Sq=Sq, H=H, D=D)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Sq, H * D), q.dtype),
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024,
+            dimension_semantics=("parallel", "arbitrary")),
+    )(table, lengths, qp, kp, vp)
+    return out.reshape(B, Sq, H, D)
+
+
 def supported(q, k, mask, layout="BHTD"):
     """Can the fused kernel take this call? (shape/dtype/mask gate —
     dropout works on every supported shape, so it is not a criterion)"""

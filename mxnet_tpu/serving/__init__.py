@@ -16,13 +16,24 @@ nodes own full KV pages — ServingEngine(prefix_cache=True) attaches a
 new request's cached prompt prefix by page-table surgery and prefills
 only the uncached suffix (O(prompt) → O(suffix)).
 
+ServingEngine(speculative=True) amortizes the decode forward over
+several tokens: a host-side prompt-lookup drafter (serving/
+speculative.py) proposes up to spec_tokens-1 candidates from the
+request's own history and ONE multi-query ragged-attention forward
+(ops/pallas_attention.ragged_mq_decode_attention) verifies them all —
+greedy output bit-identical to spec-off, sampled output distribution-
+preserving via rejection sampling on the per-request RNG streams.
+
 See docs/SERVING.md for the architecture and slot lifecycle.
 """
-from .sampling import sample_tokens, slot_keys  # noqa: F401
+from .sampling import filtered_logits, sample_tokens, slot_keys  # noqa: F401
 from .scheduler import Request, SlotScheduler, QueueFullError  # noqa: F401
 from .page_pool import PagePool  # noqa: F401
 from .prefix_cache import PrefixCache  # noqa: F401
+from .speculative import PromptLookupProposer, verify_tokens  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
 
 __all__ = ["Request", "SlotScheduler", "QueueFullError", "ServingEngine",
-           "PagePool", "PrefixCache", "sample_tokens", "slot_keys"]
+           "PagePool", "PrefixCache", "PromptLookupProposer",
+           "filtered_logits", "sample_tokens", "slot_keys",
+           "verify_tokens"]

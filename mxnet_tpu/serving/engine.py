@@ -25,6 +25,17 @@ Execution model (docs/SERVING.md):
     ~24 ms/step of host dispatch tax over a remote tunnel; at one
     token per step that tax would dominate decode, so the block size K
     amortizes it K-fold.
+  * SPECULATIVE mode (speculative=True) replaces the K-step scan with
+    ONE multi-query forward per dispatch: a host-side prompt-lookup
+    drafter (serving/speculative.py) proposes up to spec_tokens-1
+    candidates from each request's own history, the multi-query ragged
+    kernel verifies all of them under per-position causal offsets, and
+    only the accepted count advances the slot's length — greedy output
+    bit-identical to spec-off, sampled output distribution-preserving.
+  * Per-slot scalar state (lengths, budgets, sampling knobs, tables,
+    page_lock) is DEVICE-RESIDENT between dispatches; admission/finish/
+    cancel upload one slot's delta in one jitted scatter (_sync_slot),
+    so a decode dispatch pays zero host->device state uploads.
   * Between dispatches the host frees finished slots (releasing page
     leases back to the pool/prefix cache) and admits queued requests
     (FIFO) — continuous batching: nobody waits for the slowest
@@ -56,6 +67,7 @@ from .page_pool import PagePool
 from .prefix_cache import PrefixCache
 from .sampling import sample_tokens, slot_keys
 from .scheduler import Request, SlotScheduler
+from .speculative import PromptLookupProposer, verify_tokens
 
 __all__ = ["ServingEngine"]
 
@@ -103,6 +115,16 @@ def _engine_metrics(eid):
         "prefix_evicted_pages": c(
             "serving_prefix_cache_evicted_pages_total",
             "cached pages reclaimed by the LRU-by-leaf policy", _E),
+        "spec_draft_tokens": c(
+            "serving_spec_draft_tokens_total",
+            "draft tokens proposed by the prompt-lookup drafter", _E),
+        "spec_accepted_tokens": c(
+            "serving_spec_accepted_tokens_total",
+            "draft tokens accepted by verification and emitted", _E),
+        "spec_rollbacks": c(
+            "serving_spec_rollbacks_total",
+            "draft tokens rejected by verification (their KV stays "
+            "invisible and is overwritten in place)", _E),
         "queue_depth": g("serving_queue_depth",
                          "requests waiting for a slot", _E),
         "slot_occupancy": g("serving_slot_occupancy",
@@ -156,6 +178,16 @@ class ServingEngine:
     one full slot-set, num_slots * pages_per_slot). Sampled output is
     bit-identical with the cache on or off.
 
+    speculative=True turns on prompt-lookup speculative decoding
+    (serving/speculative.py, docs/SERVING.md): each decode dispatch
+    feeds spec_tokens positions per slot — the current token plus up to
+    spec_tokens-1 n-gram drafts from the request's own history — and
+    ONE multi-query verification forward emits every accepted token.
+    Greedy output is bit-identical to speculative=False; sampled output
+    is distribution-preserving and reproducible across schedules.
+    decode_block is ignored in this mode (a dispatch is one forward).
+    spec_max_ngram/spec_min_ngram bound the lookup n-gram sizes.
+
     Every engine reports into mx.telemetry as per-engine labeled
     children (docs/OBSERVABILITY.md): TTFT, admission wait, per-token
     decode latency, queue depth, slot occupancy, dispatch counts/wall
@@ -166,7 +198,8 @@ class ServingEngine:
     def __init__(self, model, num_slots, max_length=None, page_size=64,
                  decode_block=8, attn_impl="auto", prefill_bucket=None,
                  dtype=None, max_queue=None, prefix_cache=False,
-                 prefix_cache_pages=None):
+                 prefix_cache_pages=None, speculative=False,
+                 spec_tokens=4, spec_max_ngram=3, spec_min_ngram=1):
         self.model = model
         cfg = model.config
         self.num_slots = int(num_slots)
@@ -185,6 +218,19 @@ class ServingEngine:
             raise MXNetError("decode_block must be >= 1")
         self.attn_impl = attn_impl
         self.prefill_bucket = int(prefill_bucket or page_size)
+        self.speculative = bool(speculative)
+        self.spec_tokens = int(spec_tokens)
+        if self.speculative:
+            if self.spec_tokens < 2:
+                raise MXNetError("spec_tokens must be >= 2 (the current "
+                                 "token + at least one draft)")
+            self._proposer = PromptLookupProposer(
+                self.spec_tokens - 1, max_ngram=spec_max_ngram,
+                min_ngram=spec_min_ngram)
+            # per-slot token history (prompt + emitted) the prompt-lookup
+            # drafter matches against — the request's OWN history only,
+            # so drafting is schedule-independent
+            self._hist = [None] * int(num_slots)
         self.scheduler = SlotScheduler(num_slots, max_queue=max_queue)
 
         self._params = list(model.collect_params().values())
@@ -233,7 +279,12 @@ class ServingEngine:
 
         self._prefill_programs = LRUTraceCache(
             max(2 * (max_length // self.prefill_bucket), 8))
-        self._decode_program = None
+        # decode programs come in two flavors selected PER DISPATCH: the
+        # general mixed-sampling one and a greedy-only one that skips
+        # the filtered-distribution sort and the RNG draws entirely
+        # (greedy batches dominate production serving; greedy rows are
+        # bit-identical through either program)
+        self._decode_programs = {}
 
         def _copy_page(kp, vp, src, dst):
             # CoW split: clone one physical page's (L, S, H, D) slab
@@ -241,6 +292,17 @@ class ServingEngine:
                     vp.at[:, dst].set(vp[:, src]))
 
         self._copy_page_fn = jax.jit(_copy_page, donate_argnums=(0, 1))
+        # the per-slot scalar state is DEVICE-RESIDENT between decode
+        # dispatches: the decode program reads these arrays directly and
+        # returns the updated ones, and the host uploads deltas only on
+        # admission/finish/cancel (_sync_slot) — not ~12 small
+        # jnp.asarray transfers on every dispatch
+        self._upload_fn = self._build_slot_upload()
+        self._dstate = tuple(jnp.asarray(a) for a in (
+            self._lengths, self._cur_tok, self._done, self._remaining,
+            self._counters, self._seeds, self._temp, self._top_k,
+            self._top_p, self._do_sample, self._eos, self._table_host))
+        self._d_lock = jnp.asarray(self._page_lock_host())
         self._eid = str(next(_engine_ids))
         self._metrics = _engine_metrics(self._eid)
         self._metrics["num_slots"].set(self.num_slots)
@@ -266,6 +328,9 @@ class ServingEngine:
             "prefix_misses": int(m["prefix_misses"].value),
             "prefix_tokens_saved": int(m["prefix_tokens_saved"].value),
             "prefix_evicted_pages": int(m["prefix_evicted_pages"].value),
+            "spec_draft_tokens": int(m["spec_draft_tokens"].value),
+            "spec_accepted_tokens": int(m["spec_accepted_tokens"].value),
+            "spec_rollbacks": int(m["spec_rollbacks"].value),
             "prefix_cache_pages": int(m["prefix_cache_pages"].value),
             "prefix_pages_shared": int(m["prefix_pages_shared"].value),
             "pool_free_pages": int(m["pool_free_pages"].value),
@@ -380,6 +445,30 @@ class ServingEngine:
         by_id = {r.id: r for r in reqs}
         self.serve(reqs)
         return [by_id[r.id].output_tokens for r in reqs]
+
+    # -- device-resident slot state ----------------------------------------
+    def _build_slot_upload(self):
+        """One jitted scatter that refreshes EVERY device-resident
+        per-slot array for one slot in a single dispatch."""
+        def upload(state, slot, vals, row):
+            *scalars, table = state
+            out = tuple(a.at[slot].set(v) for a, v in zip(scalars, vals))
+            return out + (table.at[slot].set(row),)
+        return jax.jit(upload, donate_argnums=(0,))
+
+    def _sync_slot(self, slot):
+        """Upload one slot's host-side scalar state (plus its page-table
+        row and the pool's page_lock mask, which change in the same
+        events) to the device-resident copies. Called on admission,
+        finish and cancel — never per decode dispatch."""
+        vals = (self._lengths[slot], self._cur_tok[slot],
+                self._done[slot], self._remaining[slot],
+                self._counters[slot], self._seeds[slot],
+                self._temp[slot], self._top_k[slot], self._top_p[slot],
+                self._do_sample[slot], self._eos[slot])
+        self._dstate = self._upload_fn(self._dstate, np.int32(slot),
+                                       vals, self._table_host[slot])
+        self._d_lock = jnp.asarray(self._page_lock_host())
 
     # -- pages -------------------------------------------------------------
     def _page_lock_host(self):
@@ -545,11 +634,29 @@ class ServingEngine:
             else req.eos_token_id
         self._done[slot] = bool(done0) or cap <= 1
         if self._done[slot]:
-            return self._finish(slot)
+            return self._finish(slot)       # _release_slot syncs
+        if self.speculative:
+            self._hist[slot] = list(req.prompt) + [first]
+        self._sync_slot(slot)
         return None
 
     # -- decode ------------------------------------------------------------
-    def _build_decode(self):
+    def _decode_fn(self):
+        """The decode program for this dispatch: speculative or plain,
+        greedy-only (no sort/RNG in-program) when no active slot
+        samples. Both flavors are cached — at most two compiles per
+        mode, never per admission."""
+        greedy_only = not bool(
+            self._do_sample[self.scheduler.active_slots].any())
+        key = (self.speculative, greedy_only)
+        fn = self._decode_programs.get(key)
+        if fn is None:
+            fn = self._build_spec_decode(greedy_only) if self.speculative \
+                else self._build_decode(greedy_only)
+            self._decode_programs[key] = fn
+        return fn
+
+    def _build_decode(self, greedy_only=False):
         model, params = self.model, self._params
         K, impl = self.decode_block, self.attn_impl
 
@@ -573,9 +680,14 @@ class ServingEngine:
                     tok_in = jnp.where(active, cur_tok, 0)
                     logits, cache = model.forward(
                         NDArray(tok_in[:, None]), cache)
-                    keys = slot_keys(seeds, counters)
-                    nxt = sample_tokens(logits._data[:, -1, :], keys,
-                                        do_sample, temp, top_k, top_p)
+                    if greedy_only:
+                        nxt = jnp.argmax(logits._data[:, -1, :],
+                                         axis=-1).astype(jnp.int32)
+                    else:
+                        keys = slot_keys(seeds, counters)
+                        nxt = sample_tokens(logits._data[:, -1, :], keys,
+                                            do_sample, temp, top_k,
+                                            top_p)
                     new_len = jnp.where(active, cache.length, lengths)
                     new_rem = jnp.where(active, remaining - 1, remaining)
                     hit_eos = (nxt == eos) & (eos >= 0)
@@ -600,24 +712,24 @@ class ServingEngine:
         return jax.jit(decode, donate_argnums=(1, 2))
 
     def _decode_block(self):
-        if self._decode_program is None:
-            self._decode_program = self._build_decode()
+        if self.speculative:
+            return self._spec_decode_block()
+        fn = self._decode_fn()
         param_datas = tuple(p.data()._data for p in self._params)
+        (lengths, cur_tok, done, remaining, counters, seeds, temp,
+         top_k, top_p, do_sample, eos, table) = self._dstate
         t0 = time.perf_counter()
         with span("serving.decode_block", engine=self._eid,
                   active=self.scheduler.num_active):
-            out = self._decode_program(
-                param_datas, self._kp, self._vp,
-                jnp.asarray(self._table_host),
-                jnp.asarray(self._page_lock_host()),
-                jnp.asarray(self._lengths),
-                jnp.asarray(self._cur_tok), jnp.asarray(self._done),
-                jnp.asarray(self._remaining), jnp.asarray(self._counters),
-                jnp.asarray(self._seeds), jnp.asarray(self._temp),
-                jnp.asarray(self._top_k), jnp.asarray(self._top_p),
-                jnp.asarray(self._do_sample), jnp.asarray(self._eos))
+            out = fn(
+                param_datas, self._kp, self._vp, table, self._d_lock,
+                lengths, cur_tok, done, remaining, counters, seeds,
+                temp, top_k, top_p, do_sample, eos)
             (self._kp, self._vp, lengths, cur_tok, done, remaining,
              counters, toks, valid) = out
+            self._dstate = (lengths, cur_tok, done, remaining, counters,
+                            seeds, temp, top_k, top_p, do_sample, eos,
+                            table)
             # ONE host sync per K decoded tokens: everything small fetches
             # together (the pools stay on device, donated through)
             (self._lengths, self._cur_tok, self._done, self._remaining,
@@ -639,13 +751,143 @@ class ServingEngine:
             req.output_tokens.extend(int(t) for t in emitted)
             req.token_times.extend([now] * emitted.size)
             n_emitted += int(emitted.size)
+            # block resolution: a slot that got n of this dispatch's
+            # tokens saw dt/n per token — the ACTUAL emitted count, not
+            # the nominal K (a slot can finish mid-block, and under
+            # speculation K is not the tokens-per-dispatch at all)
+            if emitted.size:
+                m["token_latency"].observe(dt / emitted.size,
+                                           int(emitted.size))
             if self._done[slot] or self._remaining[slot] <= 0:
                 finished.append(self._finish(slot))
         m["tokens_emitted"].inc(n_emitted)
-        # block resolution (same convention as the bench): each of the
-        # block's tokens cost dt/K of dispatch wall time
-        if n_emitted:
-            m["token_latency"].observe(dt / self.decode_block, n_emitted)
+        return finished
+
+    # -- speculative decode ------------------------------------------------
+    def _build_spec_decode(self, greedy_only=False):
+        model, params = self.model, self._params
+        S, impl = self.spec_tokens, self.attn_impl
+
+        def decode(param_arrays, kp, vp, table, lock, lengths, cur_tok,
+                   done, remaining, counters, drafts, n_draft, seeds,
+                   temp, top_k, top_p, do_sample, eos):
+            saved = [p._data for p in params]
+            _trace_channel.push_frame()
+            try:
+                for p, d in zip(params, param_arrays):
+                    arr = NDArray(d)
+                    arr._grad_req = "null"
+                    p._data = arr
+                active = (~done) & (remaining > 0)
+                nd = jnp.where(active, n_draft, 0)
+                cache = PagedKVCache(kp, vp, table, lengths,
+                                     page_lock=lock, attn_impl=impl)
+                # ONE forward over [current token, drafts]: the model
+                # writes all S positions' KV at lengths..lengths+S-1 and
+                # the multi-query ragged kernel applies the per-position
+                # causal offsets; logits[:, j] is the distribution of
+                # the token after prefix..draft_j
+                toks_in = jnp.concatenate(
+                    [jnp.where(active, cur_tok, 0)[:, None],
+                     jnp.where(active[:, None], drafts, 0)], axis=1)
+                logits, cache = model.forward(NDArray(toks_in), cache)
+                emitted, n_acc = verify_tokens(
+                    logits._data, drafts, nd, seeds, counters,
+                    do_sample, temp, top_k, top_p,
+                    greedy_only=greedy_only)
+                pos = jnp.arange(S)[None, :]
+                # emit the accepted drafts + one verifier token, capped
+                # by the remaining budget, truncated at the first eos;
+                # only the emitted count advances `lengths` — rejected
+                # drafts' KV stays behind the length (invisible) and is
+                # overwritten in place by the next dispatch
+                n_em = jnp.minimum(n_acc + 1, remaining)
+                hit = ((emitted == eos[:, None]) & (eos >= 0)[:, None]
+                       & (pos < n_em[:, None]))
+                any_hit = hit.any(axis=1)
+                n_em = jnp.where(
+                    any_hit, jnp.minimum(n_em, jnp.argmax(hit, 1) + 1),
+                    n_em)
+                n_em = jnp.where(active, n_em, 0)
+                toks = jnp.where(pos < n_em[:, None], emitted, -1)
+                last = jnp.take_along_axis(
+                    emitted, jnp.maximum(n_em - 1, 0)[:, None],
+                    axis=1)[:, 0]
+                new_len = jnp.where(active, lengths + n_em, lengths)
+                new_rem = jnp.where(active, remaining - n_em, remaining)
+                new_done = done | (active & (any_hit | (new_rem <= 0)))
+                new_cur = jnp.where(active, last, cur_tok)
+                new_cnt = jnp.where(active, counters + n_em, counters)
+                n_acc_em = jnp.minimum(n_acc, n_em)   # drafts EMITTED
+            finally:
+                _trace_channel.pop_frame()
+                for p, d in zip(params, saved):
+                    p._data = d
+            return (cache.k_pages, cache.v_pages, new_len, new_cur,
+                    new_done, new_rem, new_cnt, toks, n_em, n_acc_em)
+
+        return jax.jit(decode, donate_argnums=(1, 2))
+
+    def _spec_decode_block(self):
+        fn = self._decode_fn()
+        B, S = self.num_slots, self.spec_tokens
+        drafts = np.zeros((B, S - 1), np.int32)
+        n_draft = np.zeros(B, np.int32)
+        for slot in self.scheduler.active_slots:
+            d = self._proposer.propose(self._hist[slot])
+            n_draft[slot] = d.size
+            drafts[slot, :d.size] = d
+        param_datas = tuple(p.data()._data for p in self._params)
+        (lengths, cur_tok, done, remaining, counters, seeds, temp,
+         top_k, top_p, do_sample, eos, table) = self._dstate
+        t0 = time.perf_counter()
+        with span("serving.spec_decode", engine=self._eid,
+                  active=self.scheduler.num_active,
+                  drafted=int(n_draft.sum())):
+            out = fn(
+                param_datas, self._kp, self._vp, table, self._d_lock,
+                lengths, cur_tok, done, remaining, counters,
+                jnp.asarray(drafts), jnp.asarray(n_draft), seeds, temp,
+                top_k, top_p, do_sample, eos)
+            (self._kp, self._vp, lengths, cur_tok, done, remaining,
+             counters, toks, n_em, n_acc) = out
+            self._dstate = (lengths, cur_tok, done, remaining, counters,
+                            seeds, temp, top_k, top_p, do_sample, eos,
+                            table)
+            (self._lengths, self._cur_tok, self._done, self._remaining,
+             self._counters) = (
+                np.array(lengths), np.array(cur_tok), np.array(done),
+                np.array(remaining), np.array(counters))
+            toks, n_em, n_acc = (np.asarray(toks), np.asarray(n_em),
+                                 np.asarray(n_acc))
+        now = time.perf_counter()
+        dt = now - t0
+        m = self._metrics
+        m["decode_dispatches"].inc()
+        m["decode_steps"].inc()          # one verification forward
+        m["decode_seconds"].observe(dt)
+        finished = []
+        n_emitted = 0
+        accepted = 0
+        for slot in self.scheduler.active_slots:
+            req = self.scheduler.request_at(slot)
+            n = int(n_em[slot])
+            emitted = [int(t) for t in toks[slot, :n]]
+            req.output_tokens.extend(emitted)
+            req.token_times.extend([now] * n)
+            if self._hist[slot] is not None:
+                self._hist[slot].extend(emitted)
+            n_emitted += n
+            accepted += int(n_acc[slot])
+            if n:
+                m["token_latency"].observe(dt / n, n)
+            if self._done[slot] or self._remaining[slot] <= 0:
+                finished.append(self._finish(slot))
+        m["tokens_emitted"].inc(n_emitted)
+        drafted = int(n_draft.sum())
+        m["spec_draft_tokens"].inc(drafted)
+        m["spec_accepted_tokens"].inc(accepted)
+        m["spec_rollbacks"].inc(drafted - accepted)
         return finished
 
     def _release_slot(self, slot):
@@ -658,6 +900,9 @@ class ServingEngine:
         self._remaining[slot] = 0
         self._lengths[slot] = self.max_length
         self._free_slot_pages(slot)
+        if self.speculative:
+            self._hist[slot] = None
+        self._sync_slot(slot)
         return req
 
     def _finish(self, slot):

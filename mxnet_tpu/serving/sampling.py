@@ -18,7 +18,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["slot_keys", "sample_tokens"]
+__all__ = ["slot_keys", "filtered_logits", "sample_tokens"]
 
 
 def slot_keys(seeds, counters):
@@ -29,20 +29,23 @@ def slot_keys(seeds, counters):
     return jax.vmap(one)(seeds, counters)
 
 
-def sample_tokens(logits, keys, do_sample, temperature, top_k, top_p):
-    """Select one token per slot from (B, V) logits.
+def filtered_logits(logits, temperature, top_k, top_p):
+    """Temperature-scaled (B, V) logits with the top-k/top-p filter
+    applied: cut tokens are -inf, surviving tokens keep their scaled
+    value (softmax over the result IS the sampling distribution). This
+    is the single definition of the filtered distribution — the per-step
+    sampler and the speculative-decoding rejection sampler
+    (serving/speculative.py) must agree on it exactly, or acceptance
+    would not preserve the sampling distribution.
 
-    keys: (B,) PRNG keys (slot_keys). do_sample: (B,) bool — False rows
-    take argmax. temperature: (B,) f32 (> 0; greedy rows ignore it).
-    top_k: (B,) int32, <= 0 disables. top_p: (B,) f32, >= 1 disables
-    (the full distribution must be a true no-op: f32 cumsum rounding
+    temperature: (B,) f32 (> 0). top_k: (B,) int32, <= 0 disables.
+    top_p: (B,) f32, >= 1 disables (a true no-op: f32 cumsum rounding
     above 1.0 would otherwise cut tail tokens — same guard as
-    GPT2.generate). Returns (B,) int32.
+    GPT2.generate). The top-1 token always survives (even top_p=0 /
+    top_k=1 leave exactly the argmax).
     """
     logits = logits.astype(jnp.float32)
     B, V = logits.shape
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
     # ONE descending sort serves both filters (per decode step inside the
     # compiled block — don't sort twice)
@@ -58,8 +61,20 @@ def sample_tokens(logits, keys, do_sample, temperature, top_k, top_p):
     cut_sorted |= ((cum - probs) > top_p[:, None]) & (top_p < 1.0)[:, None]
     cut = jnp.zeros_like(cut_sorted).at[
         jnp.arange(B)[:, None], sort_idx].set(cut_sorted)
-    filtered = jnp.where(cut, -jnp.inf, scaled)
+    return jnp.where(cut, -jnp.inf, scaled)
 
+
+def sample_tokens(logits, keys, do_sample, temperature, top_k, top_p):
+    """Select one token per slot from (B, V) logits.
+
+    keys: (B,) PRNG keys (slot_keys). do_sample: (B,) bool — False rows
+    take argmax of the RAW logits (temperature/filters ignored).
+    Sampled rows draw categorically from filtered_logits. Returns (B,)
+    int32.
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    filtered = filtered_logits(logits, temperature, top_k, top_p)
     sampled = jax.vmap(
         lambda key, row: jax.random.categorical(key, row))(keys, filtered)
     return jnp.where(do_sample, sampled.astype(jnp.int32), greedy)

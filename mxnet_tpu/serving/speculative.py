@@ -1,0 +1,170 @@
+"""Speculative decoding without a draft model: prompt-lookup drafting
+plus distribution-preserving in-program verification.
+
+Decode throughput is bounded by one model forward per emitted token per
+slot; speculative decoding amortizes that forward over several candidate
+tokens verified at once (the largest decode lever in the TPU serving
+literature — see docs/SERVING.md "Speculative decoding"). No draft model
+runs here: the PROPOSER is a host-side n-gram lookup over the request's
+own prompt + emitted history (prompt-lookup decoding), which is free,
+and pays off exactly on the workloads production decode is full of —
+code, templated JSON, multi-turn chat, retrieval-augmented answers that
+quote their context.
+
+The two halves:
+
+  * PromptLookupProposer (host): match the last n-gram of a request's
+    history against earlier occurrences and draft the continuation of
+    the match. Pure function of the request's own history — drafts
+    never depend on the slot, the schedule, or co-batched requests, so
+    the reproducibility contract of serving/sampling.py survives.
+  * verify_tokens (in-program): one multi-query forward has produced
+    logits for positions [current token, draft_1 .. draft_{S-1}];
+    acceptance walks the drafts left to right.
+      - greedy slots accept draft j+1 iff it equals argmax(logits_j) —
+        the emitted tokens are EXACTLY the spec-off greedy stream, bit
+        for bit.
+      - sampled slots run standard speculative rejection sampling
+        against the filtered distribution p_j (sampling.filtered_logits,
+        the same definition the plain sampler uses). The prompt-lookup
+        proposal is a point mass, so draft d is accepted with
+        probability p_j(d), and a rejection samples from the residual
+        p_j with d removed — the emitted marginal is exactly p_j
+        (distribution-preserving, the Leviathan/Chen speculative
+        sampling identity specialized to a deterministic proposer).
+
+RNG contract: the token at request-stream index i derives every random
+decision from fold_in(PRNGKey(seed), i) — fold_in(key, 1) for the accept
+uniform, fold_in(key, 2) for the residual draw, and the UNSPLIT key for
+a position with no draft (so a dispatch with zero drafts is
+bit-identical to the spec-off sampler). Output therefore depends only on
+(seed, token index, the request's own history) — reproducible across
+schedules, slot counts, and acceptance histories.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .sampling import filtered_logits
+
+__all__ = ["PromptLookupProposer", "verify_tokens"]
+
+
+class PromptLookupProposer:
+    """Draft up to `max_draft` tokens by n-gram lookup over a history.
+
+    Tries n-gram sizes from `max_ngram` down to `min_ngram`: take the
+    last n tokens, find their EARLIEST earlier occurrence (the earliest
+    match leaves the longest continuation — on cyclic text the recent
+    matches sit too close to the end to extrapolate), and draft the
+    tokens that followed it. Stateless: propose() is a pure function of
+    the history it is handed, which is what keeps drafting schedule-
+    independent.
+    """
+
+    def __init__(self, max_draft, max_ngram=3, min_ngram=1):
+        if max_draft < 1:
+            raise ValueError("max_draft must be >= 1")
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError("need 1 <= min_ngram <= max_ngram")
+        self.max_draft = int(max_draft)
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def propose(self, history):
+        """history: 1-D int sequence (prompt + emitted so far). Returns
+        an int32 array of 0..max_draft draft tokens (empty = no match;
+        the dispatch then degenerates to plain one-token decode)."""
+        h = np.asarray(history, np.int32)
+        n = h.size
+        for k in range(min(self.max_ngram, n - 1), self.min_ngram - 1, -1):
+            pat = h[n - k:]
+            windows = np.lib.stride_tricks.sliding_window_view(h[:-1], k)
+            hits = np.nonzero((windows == pat).all(axis=1))[0]
+            if hits.size:
+                start = int(hits[0]) + k
+                return h[start:start + self.max_draft].copy()
+        return np.zeros((0,), np.int32)
+
+
+def _block_keys(seeds, counters, S):
+    """(B,) seeds × (B,) stream offsets → (B, S) keys; the key at
+    [b, j] is the request's stream element for token index
+    counters[b] + j (serving/sampling.py slot_keys, widened per
+    in-dispatch position)."""
+    def one(seed, c0):
+        return jax.vmap(
+            lambda j: jax.random.fold_in(jax.random.PRNGKey(seed),
+                                         c0 + j))(jnp.arange(S))
+    return jax.vmap(one)(seeds, counters)
+
+
+def verify_tokens(logits, drafts, n_draft, seeds, counters, do_sample,
+                  temperature, top_k, top_p, greedy_only=False):
+    """Verify one speculative dispatch. Inputs:
+
+    logits:   (B, S, V) — position j conditions on [current token,
+              draft_1..draft_j]; logits_j is the distribution of the
+              token AFTER that prefix.
+    drafts:   (B, S-1) int32 draft tokens (padding past n_draft ignored).
+    n_draft:  (B,) int32 — live drafts per slot, 0..S-1.
+    seeds/counters/do_sample/temperature/top_k/top_p: per-slot arrays
+    (counters = the request-stream index of the FIRST token this
+    dispatch emits).
+    greedy_only: STATIC — when the caller knows no slot in the dispatch
+    samples (the dominant greedy-serving shape), skip the filtered
+    distribution, the stream keys, and the rejection draws entirely;
+    greedy rows are bit-identical either way.
+
+    Returns (emitted, n_acc): emitted (B, S) int32 — the token the slot
+    would emit at each position (valid through position n_acc);
+    n_acc (B,) int32 — leading drafts accepted. The caller emits
+    emitted[:, :n_acc+1] (its own eos/budget truncation on top).
+    """
+    B, S, V = logits.shape
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)     # (B, S)
+    cand_g = jnp.concatenate(
+        [drafts.astype(jnp.int32), jnp.zeros((B, 1), jnp.int32)], axis=1)
+    if greedy_only:
+        pos = jnp.arange(S)[None, :]
+        is_draft = pos < n_draft[:, None]
+        chain = jnp.cumprod(
+            ((cand_g == greedy) & is_draft).astype(jnp.int32), axis=1)
+        return greedy, chain.sum(axis=1)
+    filt = filtered_logits(
+        logits.reshape(B * S, V), jnp.repeat(temperature, S),
+        jnp.repeat(top_k, S), jnp.repeat(top_p, S)).reshape(B, S, V)
+    probs = jax.nn.softmax(filt, axis=-1)
+    # position j's candidate is drafts[:, j]; the last position never
+    # has one (it is the bonus sample when every draft was accepted)
+    cand = cand_g
+    p_cand = jnp.take_along_axis(probs, cand[..., None], axis=-1)[..., 0]
+    keys = _block_keys(seeds, counters, S)
+    # point-mass proposal => accept prob is the target mass of the draft
+    u = jax.vmap(jax.vmap(
+        lambda k: jax.random.uniform(jax.random.fold_in(k, 1))))(keys)
+    accept = jnp.where(do_sample[:, None], u < p_cand, cand == greedy)
+    pos = jnp.arange(S)[None, :]
+    is_draft = pos < n_draft[:, None]
+    chain = jnp.cumprod((accept & is_draft).astype(jnp.int32), axis=1)
+    n_acc = chain.sum(axis=1)
+    # rejection at j: sample the residual — p_j with the draft removed
+    # (renormalization is categorical's job); a reject implies
+    # p_j(draft) < 1, so the row keeps at least one finite entry
+    resid_logits = jnp.where(
+        jax.nn.one_hot(cand, V, dtype=bool), -jnp.inf, filt)
+    resid = jax.vmap(jax.vmap(
+        lambda k, row: jax.random.categorical(
+            jax.random.fold_in(k, 2), row)))(keys, resid_logits)
+    # no draft at j: a plain sample with the UNSPLIT stream key — the
+    # zero-draft dispatch is bit-identical to the spec-off sampler
+    full = jax.vmap(jax.vmap(jax.random.categorical))(keys, filt)
+    sampled = jnp.where(
+        pos < n_acc[:, None], cand,
+        jnp.where(is_draft, resid, full)).astype(jnp.int32)
+    emitted = jnp.where(do_sample[:, None], sampled, greedy)
+    return emitted, n_acc

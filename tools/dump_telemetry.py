@@ -46,7 +46,16 @@ def run_serving():
             for i, n in enumerate((3, 7, 12, 5))]
     done = eng.serve(reqs)
     assert len(done) == len(reqs)
-    return eng
+    # a second engine with speculative decoding over a repetitive
+    # workload, so the spec_* instruments carry real values in the dump
+    spec = ServingEngine(net, num_slots=2, max_length=32, page_size=8,
+                         attn_impl="xla", speculative=True,
+                         spec_tokens=4)
+    pat = rng.integers(0, cfg.vocab_size, 3).tolist()
+    sreqs = [Request(pat * (2 + i % 2) + pat[:1], 8, seed=i,
+                     request_id=100 + i) for i in range(3)]
+    assert len(spec.serve(sreqs)) == len(sreqs)
+    return eng, spec
 
 
 def run_training():
@@ -86,10 +95,10 @@ def main():
 
     if args.spans:
         telemetry.enable_jsonl(args.spans)
-    eng = None
+    eng = spec = None
     with telemetry.span("dump_telemetry.workloads"):
         if args.workload in ("serving", "both"):
-            eng = run_serving()
+            eng, spec = run_serving()
         if args.workload in ("training", "both"):
             run_training()
     telemetry.memory.sample()
@@ -111,6 +120,17 @@ def main():
               f"pages shared {s['prefix_pages_shared']}, "
               f"evicted {s['prefix_evicted_pages']}, "
               f"pool free {s['pool_free_pages']}")
+    if spec is not None:
+        # the speculative-decoding headline: acceptance rate is the
+        # quantity that decides whether speculation pays
+        s = spec.stats
+        drafted = s["spec_draft_tokens"]
+        rate = s["spec_accepted_tokens"] / drafted if drafted else 0.0
+        per_disp = s["tokens_emitted"] / max(s["decode_dispatches"], 1)
+        print(f"# speculative: acceptance {rate:.2%} "
+              f"({s['spec_accepted_tokens']}/{drafted}), "
+              f"rollbacks {s['spec_rollbacks']}, "
+              f"{per_disp:.2f} tokens/dispatch")
     if args.out:
         telemetry.dump(args.out)
     if args.spans:
