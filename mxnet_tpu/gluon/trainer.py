@@ -13,9 +13,11 @@ single device it is a no-op.
 """
 from __future__ import annotations
 
+import math
 import time
 
 from .. import telemetry
+from ..telemetry import flight as _flight
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .. import optimizer as _opt
@@ -33,6 +35,24 @@ _steps_total = telemetry.counter(
 _updates_skipped = telemetry.counter(
     "trainer_amp_skipped_steps_total",
     "steps skipped by dynamic loss scaling on gradient overflow")
+_nonfinite_steps = telemetry.counter(
+    "trainer_nonfinite_steps_total",
+    "steps whose global gradient norm was NaN/Inf (flight-recorder "
+    "sentinel; the update still applies — the dump is for triage)")
+
+
+def _grad_norm_sq(params):
+    """Global gradient norm², fetched as one host scalar per param.
+    NaN/Inf anywhere in any gradient propagates (squares are >= 0), so
+    a NaN loss — which backpropagates NaN into every grad — is caught
+    without ever seeing the loss value."""
+    total = 0.0
+    for p in params:
+        if p.grad_req == "null" or p._data is None:
+            continue
+        g = p.grad()._data
+        total += float((g.astype("float32") ** 2).sum())
+    return total
 
 
 class Trainer:
@@ -115,6 +135,20 @@ class Trainer:
                     self.zero_grad()  # skip the update, drop the bad grads
                     _updates_skipped.inc()
                     return
+            # NaN/Inf sentinel — armed only by flight.install(
+            # watch_trainer=True), so normal training never pays the
+            # per-step gradient-norm fetch. Runs AFTER the amp overflow
+            # path: dynamic loss scaling EXPECTS occasional overflow and
+            # handles it; a non-finite norm here is a real anomaly.
+            if _flight.trainer_sentinel_enabled():
+                norm_sq = _grad_norm_sq(self._params)
+                if not math.isfinite(norm_sq):
+                    _nonfinite_steps.inc()
+                    _flight.trigger(
+                        "trainer_nonfinite",
+                        {"grad_norm_sq": norm_sq,
+                         "step": int(_steps_total.value) + 1,
+                         "num_params": len(self._params)})
             self._update(ignore_stale_grad)
         finally:
             _steps_total.inc()

@@ -308,6 +308,16 @@ class ServingEngine:
         self._metrics["num_slots"].set(self.num_slots)
         self._evictions_seen = 0
         self._set_pool_gauges()
+        # live introspection: /statusz shows this engine's config +
+        # occupancy, the flight-recorder watchdog probes its progress
+        # (both hold weak refs — a collected engine just drops out),
+        # and every request records a lifecycle timeline into
+        # telemetry.request_log. dispatch_hook is a test/extension
+        # seam called at the top of every step().
+        self.dispatch_hook = None
+        telemetry.register_status_provider(
+            f"engine/{self._eid}", self._statusz)
+        telemetry.flight.watch(f"engine{self._eid}", self._flight_probe)
 
     # -- telemetry ---------------------------------------------------------
     @property
@@ -363,13 +373,59 @@ class ServingEngine:
                 m["prefix_evicted_pages"].inc(delta)
                 self._evictions_seen = pc.evicted_pages
 
+    def _statusz(self):
+        """The /statusz + flight-recorder view of this engine: static
+        config, the scheduler's slot/queue snapshot, and the headline
+        rates derived from this engine's counters."""
+        s = self.stats
+        lookups = s["prefix_hits"] + s["prefix_misses"]
+        drafted = s["spec_draft_tokens"]
+        return {
+            "config": {
+                "num_slots": self.num_slots,
+                "max_length": self.max_length,
+                "page_size": self.page_size,
+                "decode_block": self.decode_block,
+                "attn_impl": self.attn_impl,
+                "prefill_bucket": self.prefill_bucket,
+                "prefix_cache": self.prefix_cache is not None,
+                "speculative": self.speculative,
+                "spec_tokens": self.spec_tokens
+                if self.speculative else None,
+                "max_queue": self.scheduler.max_queue,
+                "total_pages": self.page_pool.num_pages,
+            },
+            "scheduler": self.scheduler.snapshot(),
+            "prefix_hit_rate": s["prefix_hits"] / lookups
+            if lookups else None,
+            "spec_acceptance": s["spec_accepted_tokens"] / drafted
+            if drafted else None,
+            "stats": s,
+        }
+
+    def _flight_probe(self):
+        """Watchdog probe (telemetry.flight): progress is the count of
+        host-visible scheduling events; busy while work is pending. A
+        busy engine whose progress freezes is a stalled dispatch loop."""
+        m = self._metrics
+        progress = int(m["prefills"].value
+                       + m["decode_dispatches"].value
+                       + m["requests_finished"].value
+                       + m["requests_cancelled"].value)
+        return progress, self.scheduler.has_work
+
     # -- public API --------------------------------------------------------
     def submit(self, request):
         """Queue a Request (validated against this engine's capacity).
         Rejections — over-long prompt, full admission queue — count into
-        serving_requests_rejected_total before raising."""
+        serving_requests_rejected_total AND record a terminal `rejected`
+        timeline, so /requests shows rejected traffic too, then raise."""
         if request.prompt_len > self.max_length:
             self._metrics["requests_rejected"].inc()
+            telemetry.request_log.terminal(
+                request.id, self._eid, "rejected",
+                reason="prompt_too_long",
+                prompt_len=request.prompt_len)
             raise MXNetError(
                 f"prompt of {request.prompt_len} tokens exceeds slot "
                 f"capacity {self.max_length}")
@@ -380,7 +436,14 @@ class ServingEngine:
             out = self.scheduler.submit(request)
         except MXNetError:
             self._metrics["requests_rejected"].inc()
+            telemetry.request_log.terminal(
+                request.id, self._eid, "rejected", reason="queue_full",
+                prompt_len=request.prompt_len)
+            telemetry.flight.note_queue_full(f"engine{self._eid}")
             raise
+        telemetry.request_log.begin(
+            request.id, self._eid, prompt_len=request.prompt_len,
+            max_new_tokens=request.max_new_tokens)
         self._metrics["queue_depth"].set(self.scheduler.num_queued)
         return out
 
@@ -399,6 +462,9 @@ class ServingEngine:
             req = self._release_slot(slot)
         req.t_finish = time.perf_counter()
         self._metrics["requests_cancelled"].inc()
+        telemetry.request_log.end(
+            request_id, self._eid, "cancelled",
+            tokens=len(req.output_tokens))
         self._set_load_gauges()
         self._set_pool_gauges()
         return req
@@ -411,6 +477,8 @@ class ServingEngine:
         """One scheduling round: admit free slots (prefill), run one
         K-step decode block, free finished slots. Returns the requests
         that finished this round."""
+        if self.dispatch_hook is not None:
+            self.dispatch_hook(self)
         finished = []
         for slot, req in self.scheduler.admit():
             fin = self._admit(slot, req)
@@ -566,7 +634,12 @@ class ServingEngine:
 
     def _admit(self, slot, req):
         Tp = req.prompt_len
+        telemetry.request_log.event(req.id, self._eid, "admitted",
+                                    slot=slot)
         offset = self._map_slot_pages(slot, req)
+        if self.prefix_cache is not None:
+            telemetry.request_log.event(
+                req.id, self._eid, "prefix_match", cached_tokens=offset)
         suffix = Tp - offset
         Tb = self._bucket(suffix, offset)
         ids = np.zeros((1, Tb), np.int32)
@@ -594,6 +667,9 @@ class ServingEngine:
         req.t_admit = now
         req.output_tokens.append(first)
         req.token_times.append(now)
+        telemetry.request_log.event(
+            req.id, self._eid, "prefill", dur=now - t0, bucket=Tb,
+            suffix_tokens=suffix, first_token=first)
         m = self._metrics
         m["prefills"].inc()
         m["prefill_tokens"].inc(suffix)
@@ -743,6 +819,7 @@ class ServingEngine:
         m["decode_dispatches"].inc()
         m["decode_steps"].inc(self.decode_block)
         m["decode_seconds"].observe(dt)
+        rl = telemetry.request_log
         finished = []
         n_emitted = 0
         for slot in self.scheduler.active_slots:
@@ -750,6 +827,9 @@ class ServingEngine:
             emitted = toks[valid[:, slot], slot]
             req.output_tokens.extend(int(t) for t in emitted)
             req.token_times.extend([now] * emitted.size)
+            if rl.enabled:
+                rl.event(req.id, self._eid, "decode", dur=dt,
+                         tokens=int(emitted.size))
             n_emitted += int(emitted.size)
             # block resolution: a slot that got n of this dispatch's
             # tokens saw dt/n per token — the ACTUAL emitted count, not
@@ -866,6 +946,7 @@ class ServingEngine:
         m["decode_dispatches"].inc()
         m["decode_steps"].inc()          # one verification forward
         m["decode_seconds"].observe(dt)
+        rl = telemetry.request_log
         finished = []
         n_emitted = 0
         accepted = 0
@@ -875,6 +956,10 @@ class ServingEngine:
             emitted = [int(t) for t in toks[slot, :n]]
             req.output_tokens.extend(emitted)
             req.token_times.extend([now] * n)
+            if rl.enabled:
+                rl.event(req.id, self._eid, "verify", dur=dt,
+                         drafted=int(n_draft[slot]),
+                         accepted=int(n_acc[slot]), tokens=n)
             if self._hist[slot] is not None:
                 self._hist[slot].extend(emitted)
             n_emitted += n
@@ -906,7 +991,13 @@ class ServingEngine:
         return req
 
     def _finish(self, slot):
+        # read the stop cause BEFORE release zeroes the slot state:
+        # budget exhaustion leaves remaining <= 0, eos leaves budget
+        reason = "budget" if self._remaining[slot] <= 0 else "eos"
         req = self._release_slot(slot)
         self._metrics["requests_finished"].inc()
+        telemetry.request_log.end(
+            req.id, self._eid, "finished", reason=reason,
+            tokens=len(req.output_tokens))
         self._set_pool_gauges()
         return req

@@ -144,6 +144,29 @@ class SlotScheduler:
         return self._active.get(slot)
 
     @property
+    def queued_ids(self):
+        """Request ids waiting for a slot, admission order."""
+        return [r.id for r in self._queue]
+
+    def snapshot(self):
+        """JSON-able view of the scheduler's state — what /statusz and
+        the flight recorder's state.json embed: the slot map (slot →
+        request id + progress), the waiting queue, and capacity."""
+        return {
+            "num_slots": self.num_slots,
+            "max_queue": self.max_queue,
+            "free_slots": sorted(self._free),
+            "queued_ids": self.queued_ids,
+            "active": {
+                str(slot): {
+                    "request_id": req.id,
+                    "prompt_len": req.prompt_len,
+                    "generated": len(req.output_tokens),
+                    "max_new_tokens": req.max_new_tokens,
+                } for slot, req in sorted(self._active.items())},
+        }
+
+    @property
     def active_slots(self):
         return sorted(self._active)
 

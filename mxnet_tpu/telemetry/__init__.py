@@ -22,6 +22,15 @@ Instrumented call sites:
 Zero dependencies: importing this package touches only the stdlib —
 never jax — so it is safe anywhere, including backend-free processes.
 
+Live introspection (docs/OBSERVABILITY.md):
+  * `serve(port)` — stdlib HTTP server on a daemon thread exposing
+    /metrics, /healthz, /statusz, /requests, /trace;
+  * `request_log` — per-request lifecycle timelines (bounded ring),
+    `chrome_trace()` exports them (plus spans) as Chrome/Perfetto
+    trace_event JSON;
+  * `flight` — anomaly-triggered flight recorder: event ring +
+    stall/queue-full/NaN watchdog, atomic once-per-trigger dumps.
+
 Quick use:
     import mxnet_tpu as mx
     mx.telemetry.snapshot()                    # nested dict
@@ -29,6 +38,8 @@ Quick use:
     mx.telemetry.dump("telemetry.json")
     with mx.telemetry.span("my.phase"):
         ...
+    mx.telemetry.serve(9100)                   # live introspection
+    mx.telemetry.flight.install(out_dir="flight_dumps")
     mx.telemetry.reset()                       # tests / bench rounds
 """
 from __future__ import annotations
@@ -39,7 +50,17 @@ from .instruments import (  # noqa: F401
 )
 from .tracing import (  # noqa: F401
     span, events, clear_events, enable_jsonl, disable_jsonl,
+    add_event_hook, remove_event_hook,
 )
+from .request_trace import (  # noqa: F401
+    RequestTrace, RequestTraceLog, request_log, chrome_trace,
+)
+from .server import (  # noqa: F401
+    IntrospectionServer, serve, stop_server, get_server,
+    register_status_provider, unregister_status_provider,
+    collect_status,
+)
+from . import flight  # noqa: F401
 from . import memory  # noqa: F401
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry",
@@ -47,7 +68,12 @@ __all__ = ["Counter", "Gauge", "Histogram", "Registry",
            "default_registry", "counter", "gauge", "histogram", "get",
            "snapshot", "render_prometheus", "dump", "reset",
            "span", "events", "clear_events", "enable_jsonl",
-           "disable_jsonl", "memory"]
+           "disable_jsonl", "add_event_hook", "remove_event_hook",
+           "RequestTrace", "RequestTraceLog", "request_log",
+           "chrome_trace", "IntrospectionServer", "serve",
+           "stop_server", "get_server", "register_status_provider",
+           "unregister_status_provider", "collect_status",
+           "flight", "memory"]
 
 #: The process-global registry every framework instrument lives in.
 default_registry = Registry()
@@ -89,7 +115,9 @@ def dump(path):
 
 
 def reset():
-    """Zero every instrument in place and clear the span ring buffer
-    (instrument/child identities survive — safe with live engines)."""
+    """Zero every instrument in place and clear the span + request
+    rings (instrument/child identities survive — safe with live
+    engines)."""
     default_registry.reset()
     clear_events()
+    request_log.clear()

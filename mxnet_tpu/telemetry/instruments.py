@@ -265,7 +265,17 @@ class Histogram(_Instrument):
         """Estimate the q-th percentile (0..100) by linear interpolation
         inside the covering bucket (histogram_quantile estimator). The
         result is exact to one bucket's width; min/max clamp the open
-        first/last buckets. NaN when empty."""
+        first/last buckets.
+
+        An EMPTY histogram returns `float("nan")` — the defined "no
+        data" value (docs/OBSERVABILITY.md "Percentiles"): NaN
+        propagates visibly through arithmetic instead of forging a
+        plausible 0.0 latency, and `math.isnan` is the idiomatic probe.
+        Snapshots and dashboards must therefore guard on `count` before
+        formatting. q outside [0, 100] raises."""
+        if not 0 <= q <= 100:
+            raise MXNetError(
+                f"percentile q must be in [0, 100], got {q!r}")
         with self._lock:
             counts = list(self._counts)
             total, mn, mx = self._count, self._min, self._max

@@ -1,0 +1,234 @@
+"""Live introspection HTTP server — stdlib-only, daemon-threaded.
+
+`mx.telemetry.serve(port)` exposes a running process to curl, a
+Prometheus scraper, and ui.perfetto.dev without adding a dependency or
+a thread the process must manage (docs/OBSERVABILITY.md "Live
+introspection server"):
+
+    /            tiny HTML index of the endpoints
+    /healthz     200 "ok" — liveness
+    /metrics     Prometheus text exposition (0.0.4) of the registry
+    /statusz     JSON: process info, registered component status
+                 (engine config/occupancy/hit-rates), jit-cache stats,
+                 device-memory watermarks
+    /requests    recent request timelines as JSON (?n=50)
+    /trace       Chrome trace_event JSON of timelines + spans
+                 (?last_ms=N) — load the response in ui.perfetto.dev
+
+Every read is a snapshot under the instrument locks, so concurrent
+scrapes during serving never tear (tests/test_introspection.py soaks
+this). Components publish into `/statusz` and flight-recorder dumps by
+registering a status provider; the registry holds weak references, so
+a garbage-collected engine silently drops out.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+import weakref
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+__all__ = ["serve", "stop_server", "get_server", "IntrospectionServer",
+           "register_status_provider", "unregister_status_provider",
+           "collect_status"]
+
+_T0 = time.time()
+_providers_lock = threading.Lock()
+_providers = {}            # name -> weakref-able callable () -> dict
+_server = None             # the default server started by serve()
+_server_lock = threading.Lock()
+
+
+def register_status_provider(name, fn):
+    """Publish `fn() -> dict` under `name` in /statusz and in flight
+    dumps. Bound methods are held via WeakMethod — a dead owner drops
+    the provider instead of leaking it."""
+    if hasattr(fn, "__self__"):
+        fn = weakref.WeakMethod(fn)
+        get = lambda ref=fn: ref()                       # noqa: E731
+    else:
+        get = lambda f=fn: f                             # noqa: E731
+    with _providers_lock:
+        _providers[str(name)] = get
+
+
+def unregister_status_provider(name):
+    with _providers_lock:
+        _providers.pop(str(name), None)
+
+
+def collect_status():
+    """{provider name: its dict} — dead weakrefs dropped, provider
+    exceptions surfaced as {"error": ...} so one broken component
+    can't blank the whole page."""
+    with _providers_lock:
+        items = list(_providers.items())
+    out = {}
+    dead = []
+    for name, get in items:
+        fn = get()
+        if fn is None:
+            dead.append(name)
+            continue
+        try:
+            out[name] = fn()
+        except Exception as e:
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    if dead:
+        with _providers_lock:
+            for name in dead:
+                _providers.pop(name, None)
+    return out
+
+
+def _statusz():
+    from . import default_registry
+
+    def _counter(name):
+        inst = default_registry.get(name)
+        return None if inst is None else inst.value
+
+    status = {
+        "time": time.time(),
+        "uptime_seconds": round(time.time() - _T0, 3),
+        "argv": list(sys.argv),
+        "python": sys.version.split()[0],
+        "jax_imported": "jax" in sys.modules,
+        "components": collect_status(),
+        "jit_cache": {
+            "retraces": _counter("jit_cache_retraces_total"),
+            "evictions": _counter("jit_cache_evictions_total"),
+        },
+    }
+    # device-memory watermarks: sample only when jax is already live —
+    # /statusz must never be the thing that initializes a backend
+    if "jax" in sys.modules:
+        try:
+            from . import memory
+            status["memory"] = memory.sample()
+        except Exception as e:
+            status["memory"] = {"error": str(e)}
+    return status
+
+
+_INDEX = """<!doctype html><title>mx.telemetry</title>
+<h1>mx.telemetry introspection</h1><ul>
+<li><a href="/metrics">/metrics</a> — Prometheus text exposition</li>
+<li><a href="/statusz">/statusz</a> — engine/process status JSON</li>
+<li><a href="/requests">/requests</a> — recent request timelines</li>
+<li><a href="/trace">/trace</a> — Chrome trace JSON
+ (open in <a href="https://ui.perfetto.dev">ui.perfetto.dev</a>;
+ ?last_ms=N for the trailing window)</li>
+<li><a href="/healthz">/healthz</a> — liveness</li>
+</ul>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mx-telemetry/1.0"
+
+    def log_message(self, fmt, *args):
+        pass                        # scrapes must not spam stderr
+
+    def _reply(self, body, ctype="application/json", code=200):
+        if isinstance(body, str):
+            body = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):              # noqa: N802 (stdlib handler name)
+        from . import render_prometheus, snapshot  # noqa: F401
+        from .request_trace import chrome_trace, request_log
+
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        try:
+            if url.path in ("/", "/index.html"):
+                self._reply(_INDEX, "text/html; charset=utf-8")
+            elif url.path == "/healthz":
+                self._reply("ok\n", "text/plain; charset=utf-8")
+            elif url.path == "/metrics":
+                self._reply(render_prometheus(),
+                            "text/plain; version=0.0.4; charset=utf-8")
+            elif url.path == "/statusz":
+                self._reply(json.dumps(_statusz(), indent=1,
+                                       sort_keys=True, default=str))
+            elif url.path == "/requests":
+                n = int(q.get("n", ["50"])[0])
+                self._reply(json.dumps(
+                    {"requests": request_log.recent(n)}, default=str))
+            elif url.path == "/trace":
+                last_ms = q.get("last_ms", [None])[0]
+                tr = chrome_trace(
+                    last_ms=float(last_ms) if last_ms else None)
+                self._reply(json.dumps(tr))
+            else:
+                self._reply(json.dumps({"error": "not found",
+                                        "path": url.path}), code=404)
+        except Exception as e:   # a broken read must answer, not hang
+            self._reply(json.dumps(
+                {"error": f"{type(e).__name__}: {e}"}), code=500)
+
+
+class IntrospectionServer:
+    """A ThreadingHTTPServer on a daemon thread. port=0 picks a free
+    port (read it back from `.port`); `stop()` shuts the listener down
+    and joins the thread."""
+
+    def __init__(self, port=0, host="127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name=f"mx-telemetry-http:{self.port}", daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __repr__(self):
+        return f"IntrospectionServer({self.url})"
+
+
+def serve(port=0, host="127.0.0.1"):
+    """Start (or return) the process's introspection server. Idempotent
+    per process: a second call returns the live server (a port mismatch
+    raises — two registries' worth of servers is never what you want;
+    construct IntrospectionServer directly for that)."""
+    global _server
+    with _server_lock:
+        if _server is not None:
+            if port not in (0, _server.port):
+                from ..base import MXNetError
+                raise MXNetError(
+                    f"introspection server already on port {_server.port}; "
+                    f"stop_server() first to move it to {port}")
+            return _server
+        _server = IntrospectionServer(port, host)
+        return _server
+
+
+def get_server():
+    return _server
+
+
+def stop_server():
+    """Stop the default server (no-op when none is running)."""
+    global _server
+    with _server_lock:
+        srv, _server = _server, None
+    if srv is not None:
+        srv.stop()

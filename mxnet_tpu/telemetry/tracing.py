@@ -15,6 +15,12 @@ a device trace never constructs an annotation.
 Event schema (one JSON object per line):
     {"name", "ts" (unix seconds at exit), "dur" (seconds), "depth",
      "parent" (enclosing span name or null), "thread", ...attrs}
+
+A span exited by a raising block records `status="error"` plus the
+exception type under `"error"` — the exception itself propagates
+untouched (`__exit__` returns False). Observers can subscribe to every
+finished span with `add_event_hook(fn)` (the flight recorder's feed);
+hook exceptions are swallowed, an observer must never break the host.
 """
 from __future__ import annotations
 
@@ -25,12 +31,13 @@ import time
 from collections import deque
 
 __all__ = ["span", "events", "clear_events", "enable_jsonl",
-           "disable_jsonl"]
+           "disable_jsonl", "add_event_hook", "remove_event_hook"]
 
 _tls = threading.local()
 _events_lock = threading.Lock()
 _events = deque(maxlen=4096)
 _jsonl = {"fh": None, "path": None}
+_event_hooks = []
 
 
 def _span_hist():
@@ -76,10 +83,10 @@ class span:
         self._t0 = time.perf_counter()
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, exc_type, exc_val, exc_tb):
         dur = time.perf_counter() - self._t0
         if self._ann is not None:
-            self._ann.__exit__(*exc)
+            self._ann.__exit__(exc_type, exc_val, exc_tb)
             self._ann = None
         st = _stack()
         if st and st[-1] is self:
@@ -88,6 +95,11 @@ class span:
         ev = {"name": self.name, "ts": time.time(), "dur": dur,
               "depth": self._depth, "parent": self._parent,
               "thread": threading.get_ident()}
+        if exc_type is not None:
+            # a raising block still records its span — tagged, so the
+            # event log shows WHERE the stack unwound, not a silent gap
+            ev["status"] = "error"
+            ev["error"] = exc_type.__name__
         if self.attrs:
             ev.update(self.attrs)
         with _events_lock:
@@ -99,6 +111,12 @@ class span:
                     fh.flush()
                 except Exception:
                     pass           # a full disk must not break serving
+            hooks = list(_event_hooks)
+        for fn in hooks:
+            try:
+                fn(ev)
+            except Exception:
+                pass               # observers must never break the host
         return False
 
 
@@ -129,3 +147,17 @@ def disable_jsonl():
             _jsonl["fh"].close()
         _jsonl["fh"] = None
         _jsonl["path"] = None
+
+
+def add_event_hook(fn):
+    """Call fn(event_dict) on every finished span (the flight
+    recorder's subscription point). Exceptions in fn are swallowed."""
+    with _events_lock:
+        if fn not in _event_hooks:
+            _event_hooks.append(fn)
+
+
+def remove_event_hook(fn):
+    with _events_lock:
+        if fn in _event_hooks:
+            _event_hooks.remove(fn)

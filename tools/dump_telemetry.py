@@ -10,6 +10,14 @@ Usage:
     python tools/dump_telemetry.py --format prometheus
     python tools/dump_telemetry.py --out telemetry.json
     python tools/dump_telemetry.py --spans spans.jsonl
+    python tools/dump_telemetry.py --trace trace.json   # -> perfetto
+    python tools/dump_telemetry.py --serve 9100 --linger 60
+
+--trace writes the run's request timelines + spans as Chrome
+trace_event JSON (open in ui.perfetto.dev). --serve starts the live
+introspection server (docs/OBSERVABILITY.md) and --linger keeps the
+process alive that many seconds so you can curl /metrics, /statusz,
+/requests, /trace.
 
 Exit code 0 means the loops ran and the snapshot round-tripped.
 """
@@ -89,10 +97,23 @@ def main():
                     help="also dump the JSON snapshot to this path")
     ap.add_argument("--spans", default=None,
                     help="append span events to this JSONL file")
+    ap.add_argument("--trace", default=None,
+                    help="write Chrome trace_event JSON (perfetto) here")
+    ap.add_argument("--serve", type=int, default=None, metavar="PORT",
+                    help="start the live introspection server (0 = any "
+                         "free port)")
+    ap.add_argument("--linger", type=float, default=0.0,
+                    help="with --serve: keep the process alive this many "
+                         "seconds after the workloads finish")
     args = ap.parse_args()
 
     from mxnet_tpu import telemetry
 
+    srv = None
+    if args.serve is not None:
+        srv = telemetry.serve(args.serve)
+        print(f"# introspection server: {srv.url} "
+              "(/metrics /statusz /requests /trace /healthz)")
     if args.spans:
         telemetry.enable_jsonl(args.spans)
     eng = spec = None
@@ -131,10 +152,29 @@ def main():
               f"({s['spec_accepted_tokens']}/{drafted}), "
               f"rollbacks {s['spec_rollbacks']}, "
               f"{per_disp:.2f} tokens/dispatch")
+    # request-timeline headline: what /requests would show for this run
+    timelines = telemetry.request_log.recent(8)
+    if timelines:
+        print(f"# request timelines: {len(telemetry.request_log.recent(10**6))}"
+              " recorded; most recent:")
+        for tr in timelines[-4:]:
+            evs = ",".join(e["event"] for e in tr["events"])
+            print(f"#   req {tr['request_id']} [{tr['status']}] {evs}")
+    if args.trace:
+        with open(args.trace, "w") as f:
+            json.dump(telemetry.chrome_trace(), f)
+        print(f"# chrome trace -> {args.trace} "
+              "(open in ui.perfetto.dev)")
     if args.out:
         telemetry.dump(args.out)
     if args.spans:
         telemetry.disable_jsonl()
+    if srv is not None and args.linger > 0:
+        import time
+        print(f"# lingering {args.linger}s — curl {srv.url}/statusz")
+        time.sleep(args.linger)
+    if srv is not None:
+        telemetry.stop_server()
     return 0
 
 
