@@ -61,6 +61,43 @@ def _emit(metric, value, unit, vs_baseline, extras=None, error=None):
     print(json.dumps(rec))
 
 
+def _device_cost_extras(eid=None):
+    """Device-cost block for a serving round's extras: per-program MFU,
+    roofline side, and compile attribution (telemetry.cost.report()),
+    so BENCH_*.json rounds carry the device-cost trajectory
+    tools/bench_compare.py consumes."""
+    from mxnet_tpu import telemetry
+    rep = telemetry.cost.report()
+    progs = {}
+    for p, s in rep["programs"].items():
+        if eid is not None and not p.startswith(f"engine{eid}/"):
+            continue
+        if not s["dispatches"] and not s["compiles"]:
+            continue
+        progs[p] = {
+            "flops": s["flops"],
+            "mfu": round(s["mfu"], 6) if s.get("mfu") is not None
+            else None,
+            "bound": s.get("bound"),
+            "compiles": s["compiles"],
+            "compile_seconds": round(s["compile_seconds"], 3),
+            "dispatches": s["dispatches"],
+        }
+    return {"device_kind": rep["device_kind"],
+            "peak_flops": rep["peak_flops"],
+            "peak_bandwidth_bytes_per_sec":
+                rep["peak_bandwidth_bytes_per_sec"],
+            "programs": progs}
+
+
+def _engine_compiles(eid):
+    """Total compiles attributed to one engine's programs."""
+    from mxnet_tpu import telemetry
+    rep = telemetry.cost.report()["programs"]
+    return sum(s["compiles"] for p, s in rep.items()
+               if p.startswith(f"engine{eid}/"))
+
+
 def bench_bert(large=False):
     import jax
     import mxnet_tpu as mx
@@ -344,11 +381,19 @@ def bench_gpt2_serving():
 
     eng = ServingEngine(net, num_slots=slots, max_length=max_len,
                         page_size=page, decode_block=block)
-    # warmup: compile the decode program + the prefill buckets the
-    # arrival mix will hit (every bucket in [p_lo, p_hi])
+    # warmup: compile the decode programs + the prefill buckets the
+    # arrival mix will hit (every bucket in [p_lo, p_hi]); a second
+    # all-sampled wave compiles the sampled decode variant too (the
+    # mix uses both, and a steady-state compile now counts as churn)
     warm = [Request(list(range(1, b + 1)), 2, request_id=f"w{b}")
             for b in range(page, max(p_hi + page, page + 1), page)]
     eng.serve(warm)
+    eng.serve([Request(list(range(1, page + 1)), 2, do_sample=True,
+                       seed=0, request_id="w-sampled")])
+    # steady state: every program is compiled; a compile inside the
+    # measured loop from here on is a retrace storm
+    eng.mark_warm()
+    compiles_at_warm = _engine_compiles(eng._eid)
     # telemetry reflects the MEASURED run only, not the warmup compiles
     eng.reset_stats()
     telemetry.clear_events()
@@ -398,9 +443,13 @@ def bench_gpt2_serving():
         "stats": eng.stats,
         "live_array_bytes_peak": int(mem.value) if mem else None,
     }
+    dc = _device_cost_extras(eng._eid)
+    dc["steady_state_compiles"] = _engine_compiles(eng._eid) \
+        - compiles_at_warm
     _emit("gpt2_serving_tokens_per_sec", round(toks_per_sec, 1),
           "tokens/sec", 0.0, extras={
               "telemetry": tele_extras,
+              "device_cost": dc,
               "requests": n_requests, "slots": slots,
               "decode_block": block, "total_tokens": total_tokens,
               "makespan_s": round(dt, 3),
@@ -652,13 +701,13 @@ def bench_gpt2_serving_speculative():
                 time.sleep(min(pending[0][0] - now, 0.01))
         dt = time.perf_counter() - t0
         total_tokens = sum(len(r.output_tokens) for r in reqs)
-        return eng.stats, total_tokens / dt, reqs
+        return eng.stats, total_tokens / dt, reqs, eng._eid
 
     # identical request streams: reseed the generator per run
     rng = np.random.default_rng(7)
-    stats_off, tps_off, reqs_off = run(speculative=False)
+    stats_off, tps_off, reqs_off, _ = run(speculative=False)
     rng = np.random.default_rng(7)
-    stats_on, tps_on, reqs_on = run(speculative=True)
+    stats_on, tps_on, reqs_on, eid_on = run(speculative=True)
     # correctness ride-along: greedy requests must match bit for bit
     # (sampled ones are distribution-preserving, not bit-identical)
     mismatch = sum(
@@ -683,6 +732,7 @@ def bench_gpt2_serving_speculative():
                   stats_off["tokens_emitted"]
                   / max(stats_off["decode_dispatches"], 1), 2),
               "greedy_mismatches": mismatch,
+              "device_cost": _device_cost_extras(eid_on),
               "requests": n_requests, "slots": slots,
               "spec_tokens": spec_tokens, "decode_block_off": block,
               "head_lens": f"U[{h_lo},{h_hi}]",
@@ -702,10 +752,12 @@ def bench_gpt2_serving_speculative():
 def bench_gpt2_serving_introspection():
     """Live-observability overhead: the SAME Poisson request stream
     served under three configs, interleaved over BENCH_AB_REPS
-    repetitions (medians) — tracing off / tracing+server on (the
-    always-on in-path cost the <2% A/B budget bounds, PERF_NOTES
-    round 10) / tracing+server+scrape-load (Prometheus-cadence
-    /metrics+/statusz+/requests plus /trace every 2 s — displaced-work
+    repetitions (medians) — tracing+cost-accounting off / on (the
+    always-on in-path cost the <2% A/B budget bounds: lifecycle
+    tracing, live server, AND the per-dispatch device-cost accounting
+    with MFU/bandwidth gauges live on /metrics, PERF_NOTES rounds
+    10-11) / on+scrape-load (Prometheus-cadence /metrics+/statusz+
+    /requests plus /trace every 2 s — displaced-work
     cost, host-core-bound). Also emits the traced run as Chrome
     trace_event JSON (BENCH_TRACE_OUT, default trace.json) — the file
     loads directly in ui.perfetto.dev. vs_baseline is the on/off
@@ -757,6 +809,7 @@ def bench_gpt2_serving_introspection():
 
     reps = int(os.environ.get("BENCH_AB_REPS", 3))
     n_trace_events = [0]
+    device_cost = [None]
 
     def run(tracing, scrape_load, id0):
         eng = ServingEngine(net, num_slots=slots, max_length=max_len,
@@ -764,9 +817,16 @@ def bench_gpt2_serving_introspection():
         warm = [Request(list(range(1, b + 1)), 2, request_id=f"w{b}")
                 for b in range(page, max(p_hi + page, page + 1), page)]
         eng.serve(warm)
+        eng.serve([Request(list(range(1, page + 1)), 2, do_sample=True,
+                           seed=0, request_id="w-sampled")])
+        eng.mark_warm()
         eng.reset_stats()
         telemetry.reset()
         telemetry.request_log.enabled = tracing
+        # the cost accounting's in-path work (note_dispatch + goodput
+        # counters) rides the same on/off switch, so the A/B bounds the
+        # WHOLE always-on observability tax
+        telemetry.cost.set_enabled(tracing)
         srv, scrapers, stop = None, [], threading.Event()
         if tracing:
             srv = telemetry.serve(0)
@@ -814,8 +874,10 @@ def bench_gpt2_serving_introspection():
             n_trace_events[0] = len(trace["traceEvents"])
             with open(trace_out, "w") as f:
                 json.dump(trace, f)
+            device_cost[0] = _device_cost_extras(eng._eid)
             telemetry.stop_server()
         telemetry.request_log.enabled = True
+        telemetry.cost.set_enabled(True)
         return total_tokens / dt, reqs
 
     # Three configs, A/B'd over `reps` interleaved repetitions with the
@@ -859,6 +921,7 @@ def bench_gpt2_serving_introspection():
                                      for k, v in tps.items()},
               "trace_json": trace_out,
               "trace_events": n_trace_events[0],
+              "device_cost": device_cost[0],
               "scrapes": {"/metrics": "50ms", "/statusz": "50ms",
                           "/requests?n=20": "50ms",
                           "/trace?last_ms=2000": "2s"},

@@ -13,11 +13,14 @@ single device it is a no-op.
 """
 from __future__ import annotations
 
+import itertools
 import math
 import time
 
 from .. import telemetry
+from ..telemetry import cost as _cost
 from ..telemetry import flight as _flight
+from ..telemetry import ledger as _ledger
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .. import optimizer as _opt
@@ -39,6 +42,9 @@ _nonfinite_steps = telemetry.counter(
     "trainer_nonfinite_steps_total",
     "steps whose global gradient norm was NaN/Inf (flight-recorder "
     "sentinel; the update still applies — the dump is for triage)")
+
+
+_trainer_ids = itertools.count()
 
 
 def _grad_norm_sq(params):
@@ -82,6 +88,28 @@ class Trainer:
         self._kvstore_type = kvstore
         self._kv_initialized = False
         self._update_on_kvstore = update_on_kvstore
+        # HBM ledger: the optimizer state this trainer materializes
+        # (momentum/variance buffers appear on first update of each
+        # key; the provider reads whatever exists right now). Weights
+        # and grads are accounted by their owners (serving engine /
+        # TrainStep); a bare eager Trainer claims only its own state.
+        _ledger.register(f"trainer/{next(_trainer_ids)}",
+                         self._hbm_ledger)
+
+    def _hbm_ledger(self):
+        def leaves(s, out):
+            if isinstance(s, (tuple, list)):
+                for x in s:
+                    leaves(x, out)
+            elif s is not None and (hasattr(s, "nbytes")
+                                    or hasattr(s, "_data")):
+                out.append(s)
+            return out
+
+        arrays = []
+        for state in self._updaters.states.values():
+            leaves(state, arrays)
+        return {"optimizer_state": arrays}
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -152,7 +180,12 @@ class Trainer:
             self._update(ignore_stale_grad)
         finally:
             _steps_total.inc()
-            _step_seconds.observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            _step_seconds.observe(dt)
+            # wall-only cost attribution (the eager trainer has no
+            # single compiled program to cost_analysis; the fused
+            # parallel.TrainStep registers real FLOPs under train_step)
+            _cost.note_dispatch("trainer.step", dt)
 
     def allreduce_grads(self):
         """Parity: Trainer.allreduce_grads. Under a mesh the gradients are

@@ -21,6 +21,8 @@ trace side-channel, dropout draws from a per-step key.
 """
 from __future__ import annotations
 
+import itertools
+import time
 from contextlib import nullcontext as _nullcontext
 
 import jax
@@ -31,6 +33,8 @@ from .. import autograd, rng as _rng
 from ..base import MXNetError
 from ..gluon.block import _trace_channel
 from ..ndarray.ndarray import NDArray
+from ..telemetry import cost as _cost
+from ..telemetry import ledger as _ledger
 from .mesh import PartitionSpec, current_mesh, mesh_scope, named_sharding
 
 __all__ = ["TrainStep", "EvalStep"]
@@ -44,6 +48,9 @@ def _mesh_ctx(mesh):
     """Scope for trace-inducing calls: ops (attention impl='auto') consult
     current_mesh() during tracing to pick sharded routes."""
     return mesh_scope(mesh) if mesh is not None else _nullcontext()
+
+
+_step_ids = itertools.count()
 
 
 class TrainStep:
@@ -164,8 +171,50 @@ class TrainStep:
         self._last_sig = None
         self._last_single_sig = None
         self._meta = {}
+        # device-cost + HBM-ledger integration (docs/OBSERVABILITY.md):
+        # per-dispatch wall attribution is always on (cheap);
+        # register_cost_analysis() adds the XLA FLOP/byte figures (it
+        # re-traces, so it is an explicit call, not a hot-path default)
+        self._cost_key = f"train_step{next(_step_ids)}"
+        _ledger.register(self._cost_key, self._hbm_ledger)
         if self.mesh is not None:
             self._place_sharded()
+
+    def _hbm_ledger(self):
+        """telemetry.ledger provider: the step's donated device state —
+        its own parameter copies, optimizer state, compression
+        residuals (ledger dedupes anything shared elsewhere)."""
+        return {
+            "params": list(self._param_arrays),
+            "optimizer_state": list(
+                jax.tree_util.tree_leaves(self._opt_states)),
+            "residuals": list(self._residuals),
+        }
+
+    def register_cost_analysis(self, sig=None):
+        """Register the compiled step's XLA cost analysis with
+        telemetry.cost (keyed `<cost_key>/step` or `/run_steps`), so
+        the dispatch walls already being attributed turn into live MFU
+        and roofline gauges. Re-traces the program once — call it from
+        a bench/startup path, not per step. Returns the cost record or
+        None when the backend reports no costs."""
+        if sig is None:
+            sig = self._last_single_sig or self._last_sig
+        ca = self.compiled_cost_analysis(sig=sig)
+        if not ca:
+            return None
+        d = dict(ca)
+        multi = isinstance(sig, tuple) and sig and sig[0] == "multi"
+        program = self._cost_key + ("/run_steps" if multi else "/step")
+        flops, nbytes = d.get("flops"), d.get("bytes accessed")
+        if multi:
+            # compiled_cost_analysis normalizes a K-chained program to
+            # per-step figures; the program record costs ONE DISPATCH,
+            # so scale back up to the K-step total
+            k = sig[2] if sig[2] is not None else sig[3][0][0]
+            flops = flops * k if flops else flops
+            nbytes = nbytes * k if nbytes else nbytes
+        return _cost.register_program(program, flops, nbytes)
 
     # -- sharding placement ------------------------------------------------
     def _place_sharded(self):
@@ -533,10 +582,16 @@ class TrainStep:
                     for d, s in zip(datas, bspecs))
         (tr_arrays, tr_states, scale_state, nt_arrays, key, lr,
          wd) = self._prepare_dispatch(entry, datas)
+        t0 = time.perf_counter()
         with _mesh_ctx(self.mesh):
             out = entry["jitted"](tr_arrays, tr_states, self._t,
                                   scale_state, nt_arrays,
                                   self._residuals, key, lr, wd, *datas)
+        # host dispatch wall (async — device time only when the caller
+        # syncs on the loss); turns into MFU once
+        # register_cost_analysis() has run
+        _cost.note_dispatch(self._cost_key + "/step",
+                            time.perf_counter() - t0)
         (new_tr_arrays, new_tr_states, self._t, new_scale,
          self._residuals, loss, aux) = out
         self._write_back(new_tr_arrays, new_tr_states)
@@ -660,10 +715,13 @@ class TrainStep:
                     for d, s in zip(datas, raw))
         (tr_arrays, tr_states, scale_state, nt_arrays, key, lr,
          wd) = self._prepare_dispatch(entry, datas)
+        t0 = time.perf_counter()
         with _mesh_ctx(self.mesh):
             out = entry["jitted"](tr_arrays, tr_states, self._t,
                                   scale_state, nt_arrays,
                                   self._residuals, key, lr, wd, *datas)
+        _cost.note_dispatch(self._cost_key + "/run_steps",
+                            time.perf_counter() - t0)
         (new_tr, new_states, self._t, new_scale, new_nt,
          self._residuals, losses) = out
         self._write_back(new_tr, new_states)
@@ -712,8 +770,11 @@ class TrainStep:
         does not report costs. This is the authoritative PER-STEP flop
         count for MFU math — no hand-derived estimates. sig selects a
         program from the bucket cache; default = the last SINGLE-step
-        program called (a K-chained run_steps program reports K steps of
-        flops, so its counts are divided by K before returning)."""
+        program called. A K-chained run_steps program reports PER-STEP
+        figures too: XLA's HloCostAnalysis counts a while/scan body
+        once regardless of trip count, so the lax.scan-chained program
+        already costs like one step (verified against the single-step
+        program; no division needed)."""
         if sig is None and self._last_single_sig is not None:
             sig = self._last_single_sig
         if sig is None:
@@ -723,11 +784,6 @@ class TrainStep:
             ca = compiled.cost_analysis()
             if isinstance(ca, (list, tuple)):
                 ca = ca[0] if ca else None
-            if ca and isinstance(sig, tuple) and len(sig) > 2 \
-                    and sig and sig[0] == "multi":
-                k = sig[2] if sig[2] is not None else sig[3][0][0]
-                ca = {key: (v / k if isinstance(v, (int, float)) else v)
-                      for key, v in dict(ca).items()}
             return ca
         except Exception:
             return None

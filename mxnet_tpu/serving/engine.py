@@ -58,6 +58,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .. import telemetry
+from ..telemetry import cost as _cost
+from ..telemetry import ledger as _ledger
 from ..base import MXNetError
 from ..gluon.block import LRUTraceCache, _trace_channel
 from ..models.kv_cache import PagedKVCache
@@ -125,6 +127,23 @@ def _engine_metrics(eid):
             "serving_spec_rollbacks_total",
             "draft tokens rejected by verification (their KV stays "
             "invisible and is overwritten in place)", _E),
+        "model_flops": c(
+            "serving_model_flops_total",
+            "registered cost_analysis FLOPs of every dispatched "
+            "prefill/decode/verify program (goodput numerator)", _E),
+        "wasted_flops": c(
+            "serving_wasted_flops_total",
+            "FLOPs spent on drafted-but-rejected speculative "
+            "positions (program FLOPs x rejected share)", _E),
+        "flops_per_token": g(
+            "serving_flops_per_token",
+            "model FLOPs per emitted token (goodput: "
+            "model_flops_total / tokens_emitted_total)", _E),
+        "admission_capacity": g(
+            "serving_admission_capacity",
+            "estimated max concurrent requests at the current page "
+            "budget: active slots + (free + idle cached pages) / "
+            "pages per slot", _E),
         "queue_depth": g("serving_queue_depth",
                          "requests waiting for a slot", _E),
         "slot_occupancy": g("serving_slot_occupancy",
@@ -315,9 +334,20 @@ class ServingEngine:
         # telemetry.request_log. dispatch_hook is a test/extension
         # seam called at the top of every step().
         self.dispatch_hook = None
+        # device-cost accounting (telemetry.cost, docs/OBSERVABILITY.md
+        # "Device-cost accounting"): every program this engine builds is
+        # wrapped in a CostedFunction keyed engine<eid>/<program>, so
+        # compiles are attributed and MFU/roofline gauges go live.
+        # mark_warm() flips the steady flag: any compile after that is a
+        # retrace storm the flight recorder latches a dump for.
+        self._steady = False
         telemetry.register_status_provider(
             f"engine/{self._eid}", self._statusz)
         telemetry.flight.watch(f"engine{self._eid}", self._flight_probe)
+        # HBM ledger: weights + KV page slab + device-resident slot
+        # state, with the prefix-cache-held page subset as an
+        # informational detail (it lives inside kv_pages)
+        _ledger.register(f"engine/{self._eid}", self._hbm_ledger)
 
     # -- telemetry ---------------------------------------------------------
     @property
@@ -341,6 +371,9 @@ class ServingEngine:
             "spec_draft_tokens": int(m["spec_draft_tokens"].value),
             "spec_accepted_tokens": int(m["spec_accepted_tokens"].value),
             "spec_rollbacks": int(m["spec_rollbacks"].value),
+            "model_flops": int(m["model_flops"].value),
+            "wasted_flops": int(m["wasted_flops"].value),
+            "admission_capacity": int(m["admission_capacity"].value),
             "prefix_cache_pages": int(m["prefix_cache_pages"].value),
             "prefix_pages_shared": int(m["prefix_pages_shared"].value),
             "pool_free_pages": int(m["pool_free_pages"].value),
@@ -359,6 +392,23 @@ class ServingEngine:
     def _set_load_gauges(self):
         self._metrics["queue_depth"].set(self.scheduler.num_queued)
         self._metrics["slot_occupancy"].set(self.scheduler.num_active)
+        self._metrics["admission_capacity"].set(
+            self.admission_capacity_estimate())
+
+    def admission_capacity_estimate(self):
+        """Max concurrent requests the current page budget supports:
+        the slots already decoding plus how many more worst-case
+        (full-length, zero-sharing) requests the pool could map —
+        idle prefix-cache pages count as reclaimable. Derived from the
+        same accounting the HBM ledger reports, published as
+        serving_admission_capacity (never above num_slots)."""
+        free = self.page_pool.num_free
+        if self.prefix_cache is not None:
+            idle = int((self.prefix_cache.member_mask()
+                        & (self.page_pool.refcounts() == 0)).sum())
+            free += idle
+        return min(self.scheduler.num_active + free // self._pages_per_slot,
+                   self.num_slots)
 
     def _set_pool_gauges(self):
         m = self._metrics
@@ -394,7 +444,9 @@ class ServingEngine:
                 if self.speculative else None,
                 "max_queue": self.scheduler.max_queue,
                 "total_pages": self.page_pool.num_pages,
+                "steady_state": self._steady,
             },
+            "admission_capacity": self.admission_capacity_estimate(),
             "scheduler": self.scheduler.snapshot(),
             "prefix_hit_rate": s["prefix_hits"] / lookups
             if lookups else None,
@@ -413,6 +465,69 @@ class ServingEngine:
                        + m["requests_finished"].value
                        + m["requests_cancelled"].value)
         return progress, self.scheduler.has_work
+
+    # -- device-cost accounting --------------------------------------------
+    def mark_warm(self):
+        """Declare warmup over: every program this engine should ever
+        need is compiled. Any compile after this point is steady-state
+        shape churn — the compile still succeeds, but the event is
+        flagged and an armed flight recorder latches a
+        `retrace_storm:<program>` dump naming the offending key."""
+        self._steady = True
+
+    def _steady_probe(self):
+        return self._steady
+
+    def _program(self, name):
+        """Program-signature key for telemetry.cost: engine-scoped so
+        two engines with different model configs never share (and so
+        poison) one cost record."""
+        return f"engine{self._eid}/{name}"
+
+    def _wrap_program(self, fn, name, cost_scale=1.0):
+        return _cost.CostedFunction(fn, self._program(name),
+                                    steady_fn=self._steady_probe,
+                                    cost_scale=cost_scale)
+
+    def _account_flops(self, program, wall, wasted_fraction=0.0):
+        """Per-dispatch device-cost bookkeeping: attribute the wall to
+        the program (live MFU/bandwidth gauges) and advance this
+        engine's goodput counters from the program's registered FLOPs."""
+        rec = _cost.note_dispatch(program, wall)
+        if rec is None or not rec.flops:
+            return
+        m = self._metrics
+        m["model_flops"].inc(rec.flops)
+        if wasted_fraction > 0.0:
+            m["wasted_flops"].inc(rec.flops * wasted_fraction)
+        tokens = m["tokens_emitted"].value
+        if tokens:
+            m["flops_per_token"].set(m["model_flops"].value / tokens)
+
+    def _hbm_ledger(self):
+        """telemetry.ledger provider: where this engine's HBM goes.
+        Weights are shared arrays (the ledger dedupes them across
+        engines); the prefix-cache figure is a Detail — those pages
+        live inside the kv_pages slab already counted above."""
+        out = {
+            "weights": [p.data() for p in self._params],
+            "kv_pages": [self._kp, self._vp],
+            "slot_state": list(self._dstate) + [self._d_lock],
+        }
+        # gluon-initialized params usually carry gradient buffers even
+        # when only serving — account them so /memz reconciles
+        grads = [g for g in (getattr(p._data, "_grad", None)
+                             for p in self._params if p._data is not None)
+                 if g is not None]
+        if grads:
+            out["weight_grads"] = grads
+        pc = self.prefix_cache
+        if pc is not None:
+            per_page = (int(self._kp.nbytes) + int(self._vp.nbytes)) \
+                // self.page_pool.num_pages
+            out["prefix_cache_pages"] = _ledger.Detail(
+                pc.num_pages * per_page)
+        return out
 
     # -- public API --------------------------------------------------------
     def submit(self, request):
@@ -646,7 +761,8 @@ class ServingEngine:
         ids[0, :suffix] = req.prompt[offset:]
         fn = self._prefill_programs.get(Tb)
         if fn is None:
-            fn = self._build_prefill(Tb)
+            fn = self._wrap_program(self._build_prefill(Tb),
+                                    f"prefill/{Tb}")
             self._prefill_programs[Tb] = fn
         param_datas = tuple(p.data()._data for p in self._params)
         i32 = lambda v: jnp.asarray(v, jnp.int32)  # noqa: E731
@@ -677,6 +793,7 @@ class ServingEngine:
         m["admission_wait"].observe(t0 - req.t_submit)
         m["ttft"].observe(now - req.t_submit)
         m["prefill_seconds"].observe(now - t0)
+        self._account_flops(fn.program, now - t0)
         pc = self.prefix_cache
         if pc is not None:
             if offset:
@@ -727,8 +844,16 @@ class ServingEngine:
         key = (self.speculative, greedy_only)
         fn = self._decode_programs.get(key)
         if fn is None:
-            fn = self._build_spec_decode(greedy_only) if self.speculative \
-                else self._build_decode(greedy_only)
+            variant = "greedy" if greedy_only else "sampled"
+            name = f"verify/S{self.spec_tokens}/{variant}" \
+                if self.speculative else f"decode/{variant}"
+            # the plain decode program scans K steps per dispatch and
+            # XLA costs the scan body once — scale to per-dispatch
+            fn = self._wrap_program(
+                self._build_spec_decode(greedy_only) if self.speculative
+                else self._build_decode(greedy_only), name,
+                cost_scale=1.0 if self.speculative
+                else float(self.decode_block))
             self._decode_programs[key] = fn
         return fn
 
@@ -841,6 +966,7 @@ class ServingEngine:
             if self._done[slot] or self._remaining[slot] <= 0:
                 finished.append(self._finish(slot))
         m["tokens_emitted"].inc(n_emitted)
+        self._account_flops(fn.program, dt)
         return finished
 
     # -- speculative decode ------------------------------------------------
@@ -973,6 +1099,12 @@ class ServingEngine:
         m["spec_draft_tokens"].inc(drafted)
         m["spec_accepted_tokens"].inc(accepted)
         m["spec_rollbacks"].inc(drafted - accepted)
+        # goodput: the verify program computes B x S query positions a
+        # dispatch; the drafted-but-rejected share of them is speculation
+        # waste (inactive-slot padding is a separate, structural cost)
+        self._account_flops(
+            fn.program, dt,
+            wasted_fraction=(drafted - accepted) / (B * S))
         return finished
 
     def _release_slot(self, slot):

@@ -29,7 +29,13 @@ Live introspection (docs/OBSERVABILITY.md):
     `chrome_trace()` exports them (plus spans) as Chrome/Perfetto
     trace_event JSON;
   * `flight` — anomaly-triggered flight recorder: event ring +
-    stall/queue-full/NaN watchdog, atomic once-per-trigger dumps.
+    stall/queue-full/NaN/retrace watchdog, atomic once-per-trigger
+    dumps;
+  * `cost` — device-cost accounting: per-program cost_analysis
+    registry (FLOPs, bytes), live MFU/roofline gauges, compile
+    attribution (`/compilez`);
+  * `ledger` — HBM ledger: per-subsystem byte accounting reconciled
+    against live-array watermarks (`/memz`).
 
 Quick use:
     import mxnet_tpu as mx
@@ -60,7 +66,9 @@ from .server import (  # noqa: F401
     register_status_provider, unregister_status_provider,
     collect_status,
 )
+from . import cost  # noqa: F401
 from . import flight  # noqa: F401
+from . import ledger  # noqa: F401
 from . import memory  # noqa: F401
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry",
@@ -73,7 +81,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "Registry",
            "chrome_trace", "IntrospectionServer", "serve",
            "stop_server", "get_server", "register_status_provider",
            "unregister_status_provider", "collect_status",
-           "flight", "memory"]
+           "cost", "flight", "ledger", "memory"]
 
 #: The process-global registry every framework instrument lives in.
 default_registry = Registry()

@@ -1,0 +1,454 @@
+"""Device-cost accounting: program cost registry, MFU/roofline gauges,
+and compile attribution.
+
+PRs 2 and 5 made the host side observable; this module makes the
+*device economics* observable (docs/OBSERVABILITY.md "Device-cost
+accounting"). Three pieces, one process-global program table:
+
+  * **Program cost registry** — every jitted program the framework
+    dispatches registers its XLA ``cost_analysis()`` (FLOPs, bytes
+    accessed) keyed by a program signature string (``engine0/prefill/64``,
+    ``engine0/decode/greedy``, ``train_step``). Combined with the
+    measured per-dispatch wall time it publishes live MFU
+    (``cost_mfu{program}``), achieved bandwidth, arithmetic intensity,
+    and a compute-vs-memory-bound roofline classification per program.
+  * **Compile attribution** — ``CostedFunction`` wraps a ``jax.jit``
+    callable for one fixed signature: the first call times the full
+    trace+lower+compile explicitly (AOT), extracts the cost analysis,
+    and counts ``compiles_total{program}`` / ``compile_seconds_total
+    {program}``; later calls run the compiled executable directly.
+    Compile events feed registered hooks — the flight recorder
+    subscribes so a *steady-state* retrace (shape churn after warmup)
+    latches a dump with the offending program key.
+  * **Peaks** — per-device peak FLOP/s and HBM bandwidth by device
+    kind (public Google Cloud TPU system-architecture numbers), env-
+    overridable with ``MXNET_TPU_PEAK_FLOPS`` / ``MXNET_TPU_PEAK_
+    BANDWIDTH``. The ridge point (peak_flops / peak_bw) classifies
+    each program: arithmetic intensity above the ridge is compute
+    bound, below is memory bound.
+
+In-path cost per dispatch is a handful of instrument updates (~µs
+against multi-ms dispatches); ``set_enabled(False)`` turns the in-path
+accounting into a no-op for A/B runs (the AOT wrapping itself stays —
+it is structural, not per-dispatch work).
+
+Stdlib-only at import: jax is imported lazily inside ``peaks()`` (and
+only when a device has necessarily been initialized by the caller).
+"""
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+
+__all__ = ["CostedFunction", "register_program", "record_compile",
+           "note_dispatch", "get", "report", "peaks", "set_enabled",
+           "enabled", "add_compile_hook", "remove_compile_hook",
+           "reset_programs"]
+
+_lock = threading.Lock()
+_programs = {}             # program key -> _ProgramRecord
+_compile_hooks = []
+_enabled = True
+_device_peaks = None       # cached (flops, bw, kind) from the backend
+_peaks_published = None    # last (flops, bw) written to the gauges
+
+
+# (device-kind substring, (peak bf16 FLOP/s, peak HBM bytes/s)).
+# Sources: public Google Cloud TPU system-architecture pages (checked
+# 2025) — same flops table as bench.py's peak_flops(); bandwidth from
+# the per-generation spec tables (v2 700 GB/s, v3 900 GB/s, v4
+# 1228 GB/s, v5e 819 GB/s, v5p 2765 GB/s, v6e/Trillium 1640 GB/s).
+# Ordered: more specific substrings first ("v5 lite" before "v5").
+_PEAK_TABLE = (
+    ("v5 lite", (197e12, 819e9)), ("v5litepod", (197e12, 819e9)),
+    ("v5e", (197e12, 819e9)),
+    ("v6 lite", (918e12, 1640e9)), ("v6e", (918e12, 1640e9)),
+    ("v5p", (459e12, 2765e9)),
+    ("v4", (275e12, 1228e9)),
+    ("v5", (459e12, 2765e9)),
+    ("v3", (123e12, 900e9)),
+    ("v2", (45e12, 700e9)),
+)
+# nominal single-core numbers so CPU smoke runs produce finite ratios
+_FALLBACK_PEAKS = (1e12, 100e9)
+
+
+class _ProgramRecord:
+    """One program's registered cost + accumulated compile/dispatch
+    totals (mirrored onto labeled instruments; this object is the
+    /compilez + report() source of truth)."""
+
+    __slots__ = ("program", "flops", "bytes_accessed", "source",
+                 "compiles", "compile_seconds", "dispatches",
+                 "dispatch_seconds", "last_seconds", "last_compile_ts")
+
+    def __init__(self, program):
+        self.program = program
+        self.flops = None
+        self.bytes_accessed = None
+        self.source = None
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.dispatches = 0
+        self.dispatch_seconds = 0.0
+        self.last_seconds = None
+        self.last_compile_ts = None
+
+
+_P = ("program",)
+_metrics_cache = None
+
+
+def _metrics():
+    """Get-or-create the cost instrument family (lazy so importing
+    telemetry stays declaration-free until cost accounting is used)."""
+    global _metrics_cache
+    if _metrics_cache is None:
+        from . import counter, gauge
+        _metrics_cache = {
+            "compiles": counter(
+                "compiles_total",
+                "trace+lower+compile events per program signature", _P),
+            "compile_seconds": counter(
+                "compile_seconds_total",
+                "wall seconds spent compiling, per program signature",
+                _P),
+            "dispatches": counter(
+                "cost_dispatches_total",
+                "cost-accounted dispatches per program", _P),
+            "dispatch_seconds": counter(
+                "cost_dispatch_seconds_total",
+                "accumulated dispatch wall seconds per program", _P),
+            "program_flops": gauge(
+                "cost_program_flops",
+                "XLA cost_analysis FLOPs of one dispatch of the "
+                "program", _P),
+            "program_bytes": gauge(
+                "cost_program_bytes_accessed",
+                "XLA cost_analysis bytes accessed by one dispatch", _P),
+            "ai": gauge(
+                "cost_arithmetic_intensity",
+                "program FLOPs / bytes accessed (roofline x-axis)", _P),
+            "compute_bound": gauge(
+                "cost_compute_bound",
+                "1 = arithmetic intensity above the device ridge point "
+                "(compute bound), 0 = below (memory bound)", _P),
+            "mfu": gauge(
+                "cost_mfu",
+                "model FLOPs utilization of the last dispatch "
+                "(flops / wall / peak_flops)", _P),
+            "achieved_flops": gauge(
+                "cost_achieved_flops_per_sec",
+                "program FLOPs / last dispatch wall", _P),
+            "achieved_bw": gauge(
+                "cost_achieved_bandwidth_bytes_per_sec",
+                "program bytes accessed / last dispatch wall", _P),
+            "peak_flops": gauge(
+                "cost_peak_flops",
+                "assumed per-chip peak FLOP/s (device table or "
+                "MXNET_TPU_PEAK_FLOPS)"),
+            "peak_bw": gauge(
+                "cost_peak_bandwidth_bytes_per_sec",
+                "assumed per-chip peak HBM bytes/s (device table or "
+                "MXNET_TPU_PEAK_BANDWIDTH)"),
+            "ridge": gauge(
+                "cost_ridge_intensity",
+                "device ridge point: peak_flops / peak_bandwidth "
+                "(FLOPs per byte)"),
+        }
+    return _metrics_cache
+
+
+# -- peaks ------------------------------------------------------------------
+
+def peaks():
+    """(peak_flops, peak_bandwidth_bytes_per_sec, device_kind).
+
+    Env overrides are read every call (tests, odd hardware); the
+    device-kind lookup hits the backend once and is cached. Safe
+    without jax: falls back to nominal CPU numbers."""
+    global _device_peaks
+    if _device_peaks is None:
+        kind, table = "unknown", _FALLBACK_PEAKS
+        try:
+            import jax
+            dev = jax.devices()[0]
+            kind = str(getattr(dev, "device_kind", "") or dev.platform)
+            low = kind.lower()
+            for sub, vals in _PEAK_TABLE:
+                if sub in low:
+                    table = vals
+                    break
+        except Exception:
+            pass
+        _device_peaks = (table[0], table[1], kind)
+    flops = float(os.environ.get("MXNET_TPU_PEAK_FLOPS", 0) or 0) \
+        or _device_peaks[0]
+    bw = float(os.environ.get("MXNET_TPU_PEAK_BANDWIDTH", 0) or 0) \
+        or _device_peaks[1]
+    global _peaks_published
+    if _peaks_published != (flops, bw):     # hot path: publish on change
+        m = _metrics()
+        m["peak_flops"].set(flops)
+        m["peak_bw"].set(bw)
+        m["ridge"].set(flops / bw)
+        _peaks_published = (flops, bw)
+    return flops, bw, _device_peaks[2]
+
+
+# -- enable/disable the in-path accounting ----------------------------------
+
+def set_enabled(flag):
+    """Gate the per-dispatch accounting (note_dispatch becomes a no-op
+    returning None). Compile attribution and program registration are
+    one-time events and stay on."""
+    global _enabled
+    _enabled = bool(flag)
+
+
+def enabled():
+    return _enabled
+
+
+# -- the program table ------------------------------------------------------
+
+def _record(program):
+    rec = _programs.get(program)
+    if rec is None:
+        rec = _programs.setdefault(program, _ProgramRecord(program))
+    return rec
+
+
+def register_program(program, flops=None, bytes_accessed=None,
+                     source="xla"):
+    """Register (or refresh) a program's static cost. `flops`/`bytes_
+    accessed` of ONE dispatch; non-finite / non-positive values are
+    treated as unknown (backends that don't report costs). Returns the
+    record."""
+    def _clean(v):
+        if v is None:
+            return None
+        v = float(v)
+        return v if math.isfinite(v) and v > 0 else None
+
+    flops, bytes_accessed = _clean(flops), _clean(bytes_accessed)
+    with _lock:
+        rec = _record(program)
+        if flops is not None:
+            rec.flops = flops
+        if bytes_accessed is not None:
+            rec.bytes_accessed = bytes_accessed
+        rec.source = source
+        flops, bytes_accessed = rec.flops, rec.bytes_accessed
+    m = _metrics()
+    if flops is not None:
+        m["program_flops"].labels(program).set(flops)
+    if bytes_accessed is not None:
+        m["program_bytes"].labels(program).set(bytes_accessed)
+    if flops is not None and bytes_accessed is not None:
+        ai = flops / bytes_accessed
+        pf, pb, _ = peaks()
+        m["ai"].labels(program).set(ai)
+        m["compute_bound"].labels(program).set(
+            1.0 if ai >= pf / pb else 0.0)
+    return get(program)
+
+
+def record_compile(program, seconds, steady=False):
+    """Count one trace+lower+compile of `program` and fan the event out
+    to the compile hooks (the flight recorder's retrace-storm detector
+    rides here). `steady=True` marks a compile AFTER the owner declared
+    steady state — shape churn that should not happen."""
+    seconds = float(seconds)
+    with _lock:
+        rec = _record(program)
+        rec.compiles += 1
+        rec.compile_seconds += seconds
+        rec.last_compile_ts = time.time()
+        hooks = list(_compile_hooks)
+    m = _metrics()
+    m["compiles"].labels(program).inc()
+    m["compile_seconds"].labels(program).inc(seconds)
+    ev = {"program": program, "seconds": seconds, "steady": bool(steady),
+          "ts": time.time()}
+    for fn in hooks:
+        try:
+            fn(ev)
+        except Exception:
+            pass               # a broken subscriber must not break dispatch
+    return ev
+
+
+def note_dispatch(program, seconds):
+    """Attribute one measured dispatch wall to `program`; publishes the
+    live MFU / achieved-bandwidth gauges when the program has a
+    registered cost. Returns the program record (None when accounting
+    is disabled) — callers use `.flops` for goodput counters."""
+    if not _enabled:
+        return None
+    seconds = max(float(seconds), 1e-9)
+    with _lock:
+        rec = _record(program)
+        rec.dispatches += 1
+        rec.dispatch_seconds += seconds
+        rec.last_seconds = seconds
+        flops, nbytes = rec.flops, rec.bytes_accessed
+    m = _metrics()
+    m["dispatches"].labels(program).inc()
+    m["dispatch_seconds"].labels(program).inc(seconds)
+    if flops is not None:
+        pf, _, _ = peaks()
+        m["mfu"].labels(program).set(flops / seconds / pf)
+        m["achieved_flops"].labels(program).set(flops / seconds)
+        # re-assert the static gauge so a telemetry.reset() between
+        # bench rounds heals on the next dispatch (set only on change
+        # would read a lock anyway; one blind set is the same cost)
+        m["program_flops"].labels(program).set(flops)
+    if nbytes is not None:
+        m["achieved_bw"].labels(program).set(nbytes / seconds)
+        m["program_bytes"].labels(program).set(nbytes)
+    return rec
+
+
+def get(program):
+    """Snapshot dict of one program's record (None when unknown)."""
+    with _lock:
+        rec = _programs.get(program)
+        if rec is None:
+            return None
+        return _snap(rec)
+
+
+def _snap(rec):
+    out = {k: getattr(rec, k) for k in _ProgramRecord.__slots__}
+    if rec.flops and rec.bytes_accessed:
+        out["arithmetic_intensity"] = rec.flops / rec.bytes_accessed
+    if rec.flops and rec.last_seconds:
+        pf, pb, _ = peaks()
+        out["mfu"] = rec.flops / rec.last_seconds / pf
+        if rec.bytes_accessed:
+            out["bandwidth_util"] = (rec.bytes_accessed
+                                     / rec.last_seconds / pb)
+    return out
+
+
+def report():
+    """The /compilez + `dump_telemetry --cost` view: every program's
+    registered cost, roofline placement, compile attribution and
+    dispatch totals, plus the assumed device peaks."""
+    pf, pb, kind = peaks()
+    with _lock:
+        progs = {p: _snap(r) for p, r in sorted(_programs.items())}
+    ridge = pf / pb
+    for snap in progs.values():
+        ai = snap.get("arithmetic_intensity")
+        if ai is not None:
+            snap["bound"] = "compute" if ai >= ridge else "memory"
+    return {"device_kind": kind, "peak_flops": pf,
+            "peak_bandwidth_bytes_per_sec": pb,
+            "ridge_intensity": ridge, "programs": progs}
+
+
+def reset_programs():
+    """Forget every program record (tests / between bench rounds that
+    rebuild their engines). Instruments are left to telemetry.reset()."""
+    with _lock:
+        _programs.clear()
+
+
+# -- compile hooks ----------------------------------------------------------
+
+def add_compile_hook(fn):
+    """fn(event_dict) runs on every record_compile (the flight recorder
+    subscribes for steady-state retrace detection)."""
+    with _lock:
+        if fn not in _compile_hooks:
+            _compile_hooks.append(fn)
+
+
+def remove_compile_hook(fn):
+    with _lock:
+        try:
+            _compile_hooks.remove(fn)
+        except ValueError:
+            pass
+
+
+# -- the AOT wrapper --------------------------------------------------------
+
+def _cost_from_compiled(compiled):
+    """(flops, bytes_accessed) from an XLA Compiled, None-safe across
+    backend/version variations (list-of-dicts vs dict, missing keys,
+    sentinel -1 values)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None, None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not ca:
+        return None, None
+    d = dict(ca)
+    return d.get("flops"), d.get("bytes accessed")
+
+
+class CostedFunction:
+    """AOT wrapper around a ``jax.jit`` function for ONE fixed call
+    signature: the first call explicitly lowers + compiles (timed into
+    ``compiles_total{program}`` / ``compile_seconds_total{program}``),
+    registers the program's ``cost_analysis()`` FLOPs and bytes, and
+    caches the compiled executable; every later call runs the
+    executable directly — same arguments, same donation semantics.
+
+    ``steady_fn`` (optional, ``() -> bool``): when it returns True at
+    compile time the compile event is flagged *steady* — the flight
+    recorder treats a steady compile as a retrace storm and latches a
+    dump. Owners flip it after warmup (``ServingEngine.mark_warm()``).
+
+    ``cost_scale``: multiplier applied to the extracted FLOPs/bytes
+    before registration. XLA's HloCostAnalysis counts a while/scan body
+    ONCE regardless of trip count, so a program that runs K chained
+    steps per dispatch (the serving engine's K-step decode scan) must
+    pass its trip count here for the per-dispatch cost to be honest.
+
+    If AOT lowering fails (exotic backend), the wrapper falls back to
+    calling the jitted function directly — the compile is then timed
+    inside the first dispatch, and the program registers without cost
+    figures (MFU gauges simply stay absent)."""
+
+    __slots__ = ("_fn", "program", "_steady_fn", "_call", "_cost_scale")
+
+    def __init__(self, fn, program, steady_fn=None, cost_scale=1.0):
+        self._fn = fn
+        self.program = str(program)
+        self._steady_fn = steady_fn
+        self._call = None
+        self._cost_scale = float(cost_scale)
+
+    def __call__(self, *args):
+        call = self._call
+        if call is None:
+            t0 = time.perf_counter()
+            flops = nbytes = None
+            try:
+                compiled = self._fn.lower(*args).compile()
+                flops, nbytes = _cost_from_compiled(compiled)
+                call = compiled
+            except Exception:
+                call = self._fn        # jit compiles inside call #1
+            dt = time.perf_counter() - t0
+            self._call = call
+            s = self._cost_scale
+            register_program(self.program,
+                             flops * s if flops else flops,
+                             nbytes * s if nbytes else nbytes)
+            steady = False
+            if self._steady_fn is not None:
+                try:
+                    steady = bool(self._steady_fn())
+                except Exception:
+                    steady = False
+            record_compile(self.program, dt, steady=steady)
+        return call(*args)

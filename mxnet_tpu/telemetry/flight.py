@@ -32,6 +32,12 @@ Built-in detectors (all opt-in via `install()`):
     each step and trips `trainer_nonfinite` on NaN/Inf (a NaN loss
     backpropagates NaN into every gradient, so this catches NaN loss
     without seeing the loss).
+  * **retrace storms** — the recorder subscribes to `telemetry.cost`'s
+    compile hook: every compile becomes a ring breadcrumb, and a
+    compile flagged *steady* (the owning engine declared warmup over
+    via `mark_warm()` yet a program still compiled inside the dispatch
+    loop) trips `retrace_storm:<program key>` with the offending
+    program signature in the dump detail.
 
 Stdlib only; never imports jax.
 """
@@ -46,7 +52,7 @@ from collections import deque
 
 __all__ = ["FlightRecorder", "install", "uninstall", "get", "record",
            "trigger", "note_queue_full", "trainer_sentinel_enabled",
-           "watch", "unwatch"]
+           "latched_reasons", "watch", "unwatch"]
 
 _recorder = None
 _lock = threading.Lock()
@@ -98,14 +104,16 @@ class FlightRecorder:
         self._event_counter = counter(
             "flight_ring_events_total",
             "events captured into the flight ring")
-        # subscribe to both telemetry event streams
-        from . import tracing
+        # subscribe to both telemetry event streams + compile events
+        from . import cost, tracing
         from .request_trace import request_log
         self._span_hook = lambda ev: self.record("span", **ev)
         self._req_hook = lambda tr, ev: self.record(
             "request", request_id=tr.request_id, engine=tr.engine, **ev)
         tracing.add_event_hook(self._span_hook)
         request_log.add_hook(self._req_hook)
+        self._compile_hook = self._on_compile
+        cost.add_compile_hook(self._compile_hook)
         self._poll = float(poll_interval if poll_interval is not None
                            else max(min(self.stall_timeout / 4, 1.0), 0.01))
         self._stop = threading.Event()
@@ -152,6 +160,24 @@ class FlightRecorder:
                          "progress": progress,
                          "stall_timeout_s": self.stall_timeout})
 
+    # -- retrace storm (compile-after-warmup) ------------------------------
+    def _on_compile(self, ev):
+        """telemetry.cost compile hook: breadcrumb every compile; a
+        compile the owner flagged as steady-state (shape churn inside
+        the dispatch loop after warmup) latches `retrace_storm:<key>`
+        with the offending program signature."""
+        self.record("compile", program=ev.get("program"),
+                    seconds=ev.get("seconds"),
+                    steady=ev.get("steady", False))
+        if ev.get("steady"):
+            self.trigger(
+                f"retrace_storm:{ev.get('program')}",
+                {"program": ev.get("program"),
+                 "compile_seconds": ev.get("seconds"),
+                 "note": "a program compiled inside the dispatch loop "
+                         "after its owner declared steady state — "
+                         "unexpected shape churn"})
+
     # -- queue-full storm --------------------------------------------------
     def note_queue_full(self, name="engine"):
         """Timestamp one QueueFullError; trips `queue_full:<name>` when
@@ -197,6 +223,13 @@ class FlightRecorder:
     def dumps(self):
         return list(self._dumps)
 
+    @property
+    def latched(self):
+        """Trigger reasons that have fired and not been rearm()ed —
+        /healthz reports `degraded` while this is non-empty."""
+        with self._fired_lock:
+            return sorted(self._fired)
+
     def _dump(self, reason, detail):
         from . import snapshot
         from .request_trace import request_log
@@ -232,10 +265,11 @@ class FlightRecorder:
     def close(self):
         self._stop.set()
         self._watchdog.join(timeout=5)
-        from . import tracing
+        from . import cost, tracing
         from .request_trace import request_log
         tracing.remove_event_hook(self._span_hook)
         request_log.remove_hook(self._req_hook)
+        cost.remove_compile_hook(self._compile_hook)
 
 
 # -- module-level singleton (what the engine/trainer hooks talk to) --------
@@ -279,6 +313,13 @@ def note_queue_full(name="engine"):
     rec = _recorder
     if rec is not None:
         rec.note_queue_full(name)
+
+
+def latched_reasons():
+    """Latched trigger reasons of the armed recorder ([] when none) —
+    the /healthz degraded probe."""
+    rec = _recorder
+    return rec.latched if rec is not None else []
 
 
 def trainer_sentinel_enabled():

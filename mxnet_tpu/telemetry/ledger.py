@@ -1,0 +1,207 @@
+"""HBM ledger: per-subsystem device-byte accounting, reconciled
+against the live-array watermarks.
+
+`telemetry/memory.py` answers *how much* HBM is in use; this module
+answers *where it went* (docs/OBSERVABILITY.md "HBM ledger"). Each
+subsystem registers a **provider** — a callable returning
+``{category: value}`` where a value is:
+
+  * an array (anything with ``.nbytes``, or an NDArray wrapping one) or
+    an iterable of arrays — counted toward the accounted total with
+    **identity dedup** across every provider and category, so two
+    engines sharing one set of weights, or a category overlapping
+    another, never double-count;
+  * an ``int`` — raw bytes, counted as-is (no dedup possible);
+  * a ``Detail(int)`` — an *informational* figure published as a gauge
+    but excluded from the accounted total (e.g. the prefix-cache-held
+    subset of the KV page slab, which is already counted inside
+    ``kv_pages``).
+
+``snapshot()`` walks the providers, reconciles the accounted total
+against ``jax.live_arrays()`` (the same source as
+``memory_live_array_bytes``) and the PjRt allocator limit where the
+backend reports one (env override ``MXNET_TPU_HBM_BYTES``), and
+publishes:
+
+    ledger_bytes{component="engine/0/kv_pages"}   per category
+    ledger_accounted_bytes                        Σ deduped categories
+    ledger_unattributed_bytes                     live − accounted
+    ledger_headroom_bytes                         limit − live (when a
+                                                  limit is known)
+
+The serving engine derives its *admission capacity estimate* (max
+concurrent slots at the current page budget) from the same page
+accounting — that gauge lives with the engine
+(``serving_admission_capacity{engine}``).
+
+Registered call sites: ``ServingEngine`` (weights, KV page slab,
+device-resident slot state, prefix-cache detail), ``gluon.Trainer``
+(optimizer state), ``parallel.TrainStep`` (params, optimizer state,
+pipeline residuals). Providers are weakly held (bound methods) — a
+collected owner drops out silently, like /statusz providers.
+
+Stdlib-only at import; jax is touched only inside ``snapshot()`` and
+only when the process already initialized it.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import weakref
+
+__all__ = ["Detail", "register", "unregister", "providers", "snapshot",
+           "install"]
+
+_lock = threading.Lock()
+_providers = {}            # name -> () -> provider callable (weak-aware)
+
+
+class Detail(int):
+    """Informational byte figure: published as a gauge, excluded from
+    the accounted total (use for categories that overlap another)."""
+
+
+def register(name, fn):
+    """Publish `fn() -> {category: arrays | int | Detail}` under `name`.
+    Bound methods are held via WeakMethod — a dead owner drops the
+    provider instead of leaking it."""
+    if hasattr(fn, "__self__"):
+        ref = weakref.WeakMethod(fn)
+        get = lambda r=ref: r()                          # noqa: E731
+    else:
+        get = lambda f=fn: f                             # noqa: E731
+    with _lock:
+        _providers[str(name)] = get
+
+
+def unregister(name):
+    with _lock:
+        _providers.pop(str(name), None)
+
+
+def providers():
+    with _lock:
+        return sorted(_providers)
+
+
+def _gauges(registry):
+    g = registry.gauge
+    return {
+        "bytes": g("ledger_bytes",
+                   "HBM ledger: accounted device bytes per component "
+                   "(component = provider/category)",
+                   labelnames=("component",)),
+        "accounted": g("ledger_accounted_bytes",
+                       "HBM ledger: total bytes accounted to a "
+                       "subsystem (identity-deduped)"),
+        "unattributed": g("ledger_unattributed_bytes",
+                          "live jax.Array bytes not claimed by any "
+                          "ledger provider (live - accounted)"),
+        "headroom": g("ledger_headroom_bytes",
+                      "device capacity minus live bytes (0 when no "
+                      "capacity limit is known)"),
+    }
+
+
+def _arrays_of(value):
+    """Flatten a provider value into raw arrays; returns None when the
+    value is a plain byte count instead."""
+    if isinstance(value, int) and not isinstance(value, bool):
+        return None
+    if hasattr(value, "nbytes") or hasattr(value, "_data"):
+        value = [value]
+    out = []
+    for a in value:
+        a = getattr(a, "_data", a)         # NDArray -> jnp array
+        if a is not None and hasattr(a, "nbytes"):
+            out.append(a)
+    return out
+
+
+def snapshot(registry=None):
+    """One reconciliation pass: walk the providers, dedupe, compare
+    with the live-array total and the allocator limit, update the
+    ledger gauges, and return the full /memz dict."""
+    from . import default_registry
+    gs = _gauges(registry or default_registry)
+    with _lock:
+        items = sorted(_providers.items())
+    components = {}
+    seen = set()               # id() of every counted array
+    accounted = 0
+    dead = []
+    for name, get in items:
+        fn = get()
+        if fn is None:
+            dead.append(name)
+            continue
+        try:
+            cats = fn() or {}
+        except Exception as e:
+            components[name] = {"error": f"{type(e).__name__}: {e}"}
+            continue
+        comp = {}
+        for cat, value in cats.items():
+            if isinstance(value, Detail):
+                comp[str(cat)] = {"bytes": int(value), "detail": True}
+                gs["bytes"].labels(f"{name}/{cat}").set(int(value))
+                continue
+            arrays = _arrays_of(value)
+            if arrays is None:             # raw int bytes
+                n = int(value)
+            else:
+                n = 0
+                for a in arrays:
+                    if id(a) in seen:
+                        continue
+                    seen.add(id(a))
+                    n += int(a.nbytes)
+            comp[str(cat)] = {"bytes": n}
+            accounted += n
+            gs["bytes"].labels(f"{name}/{cat}").set(n)
+        components[name] = comp
+    if dead:
+        with _lock:
+            for name in dead:
+                _providers.pop(name, None)
+
+    out = {"components": components, "accounted_bytes": accounted}
+    live = None
+    limit = float(os.environ.get("MXNET_TPU_HBM_BYTES", 0) or 0) or None
+    in_use = None
+    if "jax" in sys.modules:       # never the thing that boots a backend
+        try:
+            from . import memory
+            mem = memory.sample(registry)
+            live = mem.get("live_array_bytes")
+            # the first device's allocator view, where reported
+            for k, v in mem.items():
+                if k.startswith("bytes_limit") and limit is None:
+                    limit = float(v)
+                if k.startswith("bytes_in_use") and in_use is None:
+                    in_use = float(v)
+        except Exception as e:
+            out["memory_error"] = str(e)
+    out["live_array_bytes"] = live
+    if live is not None:
+        out["unattributed_bytes"] = int(live - accounted)
+        gs["unattributed"].set(live - accounted)
+        if accounted:
+            out["unattributed_fraction"] = round(
+                (live - accounted) / max(live, 1), 6)
+    out["capacity_bytes"] = limit
+    used = in_use if in_use is not None else live
+    if limit is not None and used is not None:
+        out["headroom_bytes"] = int(limit - used)
+        gs["headroom"].set(limit - used)
+    gs["accounted"].set(accounted)
+    return out
+
+
+def install(registry=None):
+    """Reconcile on every snapshot/render of the registry (opt-in, like
+    memory.install — a ledger walk is O(live arrays))."""
+    from . import default_registry
+    reg = registry or default_registry
+    reg.add_collect_hook(lambda: snapshot(reg))

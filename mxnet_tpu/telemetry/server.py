@@ -6,14 +6,20 @@ a thread the process must manage (docs/OBSERVABILITY.md "Live
 introspection server"):
 
     /            tiny HTML index of the endpoints
-    /healthz     200 "ok" — liveness
+    /healthz     200 "ok" — liveness; "degraded: <reasons>" (still
+                 200, flagged body) while the flight recorder holds a
+                 latched dump
     /metrics     Prometheus text exposition (0.0.4) of the registry
-    /statusz     JSON: process info, registered component status
-                 (engine config/occupancy/hit-rates), jit-cache stats,
-                 device-memory watermarks
+    /statusz     JSON: process info (uptime, RSS, python/jax versions),
+                 registered component status (engine config/occupancy/
+                 hit-rates), jit-cache stats, device-memory watermarks
     /requests    recent request timelines as JSON (?n=50)
     /trace       Chrome trace_event JSON of timelines + spans
                  (?last_ms=N) — load the response in ui.perfetto.dev
+    /compilez    JSON: per-program compile attribution + registered
+                 cost_analysis + MFU/roofline placement (telemetry.cost)
+    /memz        JSON: the HBM ledger reconciled against live-array
+                 bytes (telemetry.ledger)
 
 Every read is a snapshot under the instrument locks, so concurrent
 scrapes during serving never tear (tests/test_introspection.py soaks
@@ -24,6 +30,7 @@ a garbage-collected engine silently drops out.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import threading
 import time
@@ -84,8 +91,34 @@ def collect_status():
     return out
 
 
+def _rss_bytes():
+    """Current resident set size. /proc on linux; ru_maxrss (the PEAK,
+    in KiB on linux) as the portable fallback; None when unknowable."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGESIZE")
+    except Exception:
+        pass
+    try:
+        import resource
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        return None
+
+
+def _versions():
+    """Interpreter + key-library versions — only libraries this process
+    already imported (probing must never initialize a backend)."""
+    out = {"python": sys.version.split()[0]}
+    for mod in ("jax", "jaxlib", "numpy"):
+        m = sys.modules.get(mod)
+        if m is not None:
+            out[mod] = getattr(m, "__version__", "unknown")
+    return out
+
+
 def _statusz():
-    from . import default_registry
+    from . import default_registry, flight
 
     def _counter(name):
         inst = default_registry.get(name)
@@ -95,8 +128,12 @@ def _statusz():
         "time": time.time(),
         "uptime_seconds": round(time.time() - _T0, 3),
         "argv": list(sys.argv),
+        "pid": os.getpid(),
+        "rss_bytes": _rss_bytes(),
+        "versions": _versions(),
         "python": sys.version.split()[0],
         "jax_imported": "jax" in sys.modules,
+        "flight_latched": flight.latched_reasons(),
         "components": collect_status(),
         "jit_cache": {
             "retraces": _counter("jit_cache_retraces_total"),
@@ -122,7 +159,11 @@ _INDEX = """<!doctype html><title>mx.telemetry</title>
 <li><a href="/trace">/trace</a> — Chrome trace JSON
  (open in <a href="https://ui.perfetto.dev">ui.perfetto.dev</a>;
  ?last_ms=N for the trailing window)</li>
-<li><a href="/healthz">/healthz</a> — liveness</li>
+<li><a href="/compilez">/compilez</a> — per-program compile
+ attribution + MFU/roofline</li>
+<li><a href="/memz">/memz</a> — HBM ledger vs live-array bytes</li>
+<li><a href="/healthz">/healthz</a> — liveness (degraded while a
+ flight dump is latched)</li>
 </ul>"""
 
 
@@ -151,7 +192,11 @@ class _Handler(BaseHTTPRequestHandler):
             if url.path in ("/", "/index.html"):
                 self._reply(_INDEX, "text/html; charset=utf-8")
             elif url.path == "/healthz":
-                self._reply("ok\n", "text/plain; charset=utf-8")
+                from . import flight
+                latched = flight.latched_reasons()
+                body = "ok\n" if not latched else \
+                    "degraded: " + ",".join(latched) + "\n"
+                self._reply(body, "text/plain; charset=utf-8")
             elif url.path == "/metrics":
                 self._reply(render_prometheus(),
                             "text/plain; version=0.0.4; charset=utf-8")
@@ -167,6 +212,14 @@ class _Handler(BaseHTTPRequestHandler):
                 tr = chrome_trace(
                     last_ms=float(last_ms) if last_ms else None)
                 self._reply(json.dumps(tr))
+            elif url.path == "/compilez":
+                from . import cost
+                self._reply(json.dumps(cost.report(), indent=1,
+                                       sort_keys=True, default=str))
+            elif url.path == "/memz":
+                from . import ledger
+                self._reply(json.dumps(ledger.snapshot(), indent=1,
+                                       sort_keys=True, default=str))
             else:
                 self._reply(json.dumps({"error": "not found",
                                         "path": url.path}), code=404)

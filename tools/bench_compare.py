@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Compare bench rounds: regression gate + trajectory table.
+
+`bench.py` emits one JSON record per workload and the driver archives
+them as `BENCH_*.json` / `BENCH_r0x.json` rounds — but until now
+nothing consumed the files, so the trajectory was write-only. This
+tool reads two or more rounds (oldest first), prints a per-metric
+trajectory table, and exits nonzero when the NEWEST round regresses
+against the OLDEST by more than the noise threshold.
+
+Accepted file shapes (auto-detected per file):
+  * driver round files: {"tail": "...bench stdout...", "parsed": {...}}
+    — every JSON line in `tail` with a "metric" key is a record;
+  * a JSON list of records (BENCH_SERVING_*.json);
+  * a single record dict ({"metric": ...});
+  * JSON-lines (bench.py stdout piped to a file).
+
+Direction is inferred from the metric/unit name: `*latency*`, `*_ms`,
+`*seconds*`, `*bytes*`, `*loss*` are lower-is-better; everything else
+(tokens/sec, img/sec, MFU fractions) is higher-is-better.
+
+Usage:
+    python tools/bench_compare.py OLD.json NEW.json [MORE.json ...]
+        [--threshold 0.05] [--metric NAME ...] [--extras KEY ...]
+
+    --threshold   noise band as a fraction (default 0.05 = 5%)
+    --metric      restrict the comparison to these metric names
+    --extras      also track these numeric extras keys (dotted paths,
+                  e.g. --extras telemetry.ttft.p99_ms) as lower-is-
+                  better unless the key says otherwise
+
+Exit codes: 0 = no regression (improvements and in-band noise are
+fine), 1 = at least one metric regressed past the threshold, 2 = bad
+input (no comparable metrics / unreadable file).
+"""
+import argparse
+import json
+import os
+import sys
+
+__all__ = ["load_records", "compare", "main"]
+
+_LOWER_BETTER = ("latency", "_ms", "seconds", "bytes", "loss",
+                 "overhead")
+
+
+def lower_is_better(name):
+    low = str(name).lower()
+    return any(t in low for t in _LOWER_BETTER)
+
+
+def _records_from_obj(obj):
+    if isinstance(obj, list):
+        return [r for r in obj if isinstance(r, dict) and "metric" in r]
+    if not isinstance(obj, dict):
+        return []
+    out = []
+    if "tail" in obj:                       # driver round file
+        for line in str(obj.get("tail", "")).splitlines():
+            line = line.strip()
+            if not (line.startswith("{") and '"metric"' in line):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec:
+                out.append(rec)
+        if not out and isinstance(obj.get("parsed"), dict) \
+                and "metric" in obj["parsed"]:
+            out.append(obj["parsed"])
+        return out
+    if "metric" in obj:
+        return [obj]
+    return []
+
+
+def load_records(path):
+    """{metric: record} for one round file (last record wins on a
+    duplicated metric — reruns within one round supersede)."""
+    with open(path) as f:
+        text = f.read()
+    records = []
+    try:
+        records = _records_from_obj(json.loads(text))
+    except ValueError:
+        pass
+    if not records:                          # JSON-lines fallback
+        for line in text.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "metric" in rec:
+                records.append(rec)
+    return {r["metric"]: r for r in records}
+
+
+def _extra(rec, dotted):
+    cur = rec.get("extras") or {}
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def compare(rounds, threshold=0.05, metrics=None, extras=()):
+    """rounds: [(label, {metric: record})] oldest first. Returns
+    (rows, regressions): rows for the table, regressions the list of
+    failing series names."""
+    series = {}                       # name -> [value-or-None per round]
+    for name in sorted({m for _, recs in rounds for m in recs}):
+        if metrics and name not in metrics:
+            continue
+        series[name] = [
+            recs.get(name, {}).get("value") for _, recs in rounds]
+        for key in extras:
+            vals = [_extra(recs.get(name, {}), key)
+                    for _, recs in rounds]
+            if any(v is not None for v in vals):
+                series[f"{name}:{key}"] = vals
+    rows, regressions = [], []
+    for name, vals in series.items():
+        present = [(i, v) for i, v in enumerate(vals) if v is not None]
+        status, change = "n/a", None
+        if len(present) >= 2:
+            (_, old), (_, new) = present[0], present[-1]
+            if old:
+                change = (new - old) / abs(old)
+                worse = -change if lower_is_better(name) else change
+                if worse < -threshold:
+                    status = "REGRESSED"
+                    regressions.append(name)
+                elif worse > threshold:
+                    status = "improved"
+                else:
+                    status = "ok"
+            else:
+                status = "ok (old=0)"
+        rows.append((name, vals, change, status))
+    return rows, regressions
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float) and abs(v) >= 1000:
+        return f"{v:,.0f}"
+    return f"{v:g}"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="compare bench rounds; nonzero exit on regression")
+    ap.add_argument("files", nargs="+", help="round files, oldest first")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="noise band fraction (default 0.05)")
+    ap.add_argument("--metric", action="append", default=None,
+                    help="only compare these metric names")
+    ap.add_argument("--extras", action="append", default=[],
+                    help="also track this dotted extras path")
+    args = ap.parse_args(argv)
+
+    rounds = []
+    for path in args.files:
+        try:
+            recs = load_records(path)
+        except OSError as e:
+            print(f"ERROR: cannot read {path}: {e}")
+            return 2
+        rounds.append((os.path.basename(path), recs))
+    rows, regressions = compare(rounds, args.threshold, args.metric,
+                                args.extras)
+    if not rows or all(r[3] == "n/a" for r in rows):
+        print("ERROR: no metric appears in two or more rounds")
+        return 2
+
+    labels = [label for label, _ in rounds]
+    name_w = max(len(r[0]) for r in rows)
+    head = "metric".ljust(name_w) + " | " + " | ".join(labels) \
+        + " | change | status"
+    print(head)
+    print("-" * len(head))
+    for name, vals, change, status in rows:
+        arrow = "" if change is None else f"{change:+.1%}"
+        print(name.ljust(name_w) + " | "
+              + " | ".join(_fmt(v) for v in vals)
+              + f" | {arrow or '-'} | {status}")
+    if regressions:
+        print(f"\nFAIL: {len(regressions)} regression(s) past "
+              f"{args.threshold:.0%}: {', '.join(regressions)}")
+        return 1
+    print(f"\nOK: no regressions past {args.threshold:.0%} across "
+          f"{len(rounds)} rounds")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,8 @@ def register_everything():
     from mxnet_tpu.serving import engine as serving_engine
     serving_engine._engine_metrics("catalog-check")
     telemetry.memory._gauges(telemetry.default_registry)
+    telemetry.cost._metrics()                  # cost/compile family
+    telemetry.ledger._gauges(telemetry.default_registry)
     with telemetry.span("catalog_check"):      # span_duration_seconds
         pass
     telemetry.flight.install(out_dir="/tmp/mx-catalog-check")

@@ -12,12 +12,16 @@ Usage:
     python tools/dump_telemetry.py --spans spans.jsonl
     python tools/dump_telemetry.py --trace trace.json   # -> perfetto
     python tools/dump_telemetry.py --serve 9100 --linger 60
+    python tools/dump_telemetry.py --cost     # MFU/roofline/compile
 
 --trace writes the run's request timelines + spans as Chrome
 trace_event JSON (open in ui.perfetto.dev). --serve starts the live
 introspection server (docs/OBSERVABILITY.md) and --linger keeps the
 process alive that many seconds so you can curl /metrics, /statusz,
-/requests, /trace.
+/requests, /trace, /compilez, /memz. --cost prints the device-cost
+headline: per-program FLOPs / arithmetic intensity / roofline side /
+MFU, compile attribution, and the HBM-ledger reconciliation against
+live-array bytes.
 
 Exit code 0 means the loops ran and the snapshot round-tripped.
 """
@@ -99,6 +103,9 @@ def main():
                     help="append span events to this JSONL file")
     ap.add_argument("--trace", default=None,
                     help="write Chrome trace_event JSON (perfetto) here")
+    ap.add_argument("--cost", action="store_true",
+                    help="print the MFU/roofline/compile headline and "
+                         "the HBM-ledger reconciliation")
     ap.add_argument("--serve", type=int, default=None, metavar="PORT",
                     help="start the live introspection server (0 = any "
                          "free port)")
@@ -152,6 +159,47 @@ def main():
               f"({s['spec_accepted_tokens']}/{drafted}), "
               f"rollbacks {s['spec_rollbacks']}, "
               f"{per_disp:.2f} tokens/dispatch")
+    if args.cost:
+        # the /compilez + /memz headline, human-shaped: where every
+        # dispatched program sits on the roofline and where HBM went
+        rep = telemetry.cost.report()
+        print(f"# device-cost: {rep['device_kind']} — peak "
+              f"{rep['peak_flops'] / 1e12:.1f} TFLOP/s, "
+              f"{rep['peak_bandwidth_bytes_per_sec'] / 1e9:.0f} GB/s, "
+              f"ridge {rep['ridge_intensity']:.1f} flop/byte")
+        for prog, s in rep["programs"].items():
+            ai = s.get("arithmetic_intensity")
+            mfu = s.get("mfu")
+            avg = (s["dispatch_seconds"] / s["dispatches"] * 1e3
+                   if s["dispatches"] else 0.0)
+            print(f"#   {prog}: "
+                  + (f"{s['flops'] / 1e6:.2f} MFLOP, " if s["flops"]
+                     else "flops n/a, ")
+                  + (f"AI {ai:.1f} ({s.get('bound', '?')}-bound), "
+                     if ai else "")
+                  + (f"MFU {mfu:.2%}, " if mfu is not None else "")
+                  + f"compiles {s['compiles']} "
+                  f"({s['compile_seconds']:.2f}s), "
+                  f"dispatches {s['dispatches']} (avg {avg:.2f} ms)")
+        led = telemetry.ledger.snapshot()
+        live = led.get("live_array_bytes")
+        unattr = led.get("unattributed_bytes")
+        print(f"# hbm ledger: accounted "
+              f"{led['accounted_bytes'] / 1e6:.2f} MB"
+              + (f" | live {live / 1e6:.2f} MB" if live is not None
+                 else "")
+              + (f" | unattributed {unattr / 1e6:.2f} MB "
+                 f"({led.get('unattributed_fraction', 0):.1%})"
+                 if unattr is not None else "")
+              + (f" | headroom {led['headroom_bytes'] / 1e6:.0f} MB"
+                 if led.get("headroom_bytes") is not None else ""))
+        for name, cats in led["components"].items():
+            parts = ", ".join(
+                f"{c} {v['bytes'] / 1e6:.2f} MB"
+                + (" (detail)" if v.get("detail") else "")
+                for c, v in cats.items() if isinstance(v, dict)
+                and "bytes" in v)
+            print(f"#   {name}: {parts}")
     # request-timeline headline: what /requests would show for this run
     timelines = telemetry.request_log.recent(8)
     if timelines:
