@@ -24,16 +24,28 @@ request's own history and ONE multi-query ragged-attention forward
 greedy output bit-identical to spec-off, sampled output distribution-
 preserving via rejection sampling on the per-request RNG streams.
 
+The serving loop is overload-hardened (docs/SERVING.md "Robustness"):
+requests carry deadlines and priority classes, a SheddingPolicy
+(serving/policy.py) sheds or down-prioritizes work from live telemetry
+before it queues, step() supervises dispatch faults (audit, rollback,
+retry, quarantine) instead of propagating them, and a seeded FaultPlan
+(serving/faults.py) drives all of it deterministically in tests.
+
 See docs/SERVING.md for the architecture and slot lifecycle.
 """
 from .sampling import filtered_logits, sample_tokens, slot_keys  # noqa: F401
-from .scheduler import Request, SlotScheduler, QueueFullError  # noqa: F401
-from .page_pool import PagePool  # noqa: F401
+from .scheduler import (Request, SlotScheduler, RejectedError,  # noqa: F401
+                        QueueFullError, ShedError)
+from .page_pool import PagePool, PagePoolExhausted  # noqa: F401
 from .prefix_cache import PrefixCache  # noqa: F401
 from .speculative import PromptLookupProposer, verify_tokens  # noqa: F401
+from .policy import SheddingPolicy  # noqa: F401
+from .faults import FaultError, FaultPlan  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
 
-__all__ = ["Request", "SlotScheduler", "QueueFullError", "ServingEngine",
-           "PagePool", "PrefixCache", "PromptLookupProposer",
+__all__ = ["Request", "SlotScheduler", "RejectedError", "QueueFullError",
+           "ShedError", "ServingEngine", "SheddingPolicy",
+           "PagePool", "PagePoolExhausted", "PrefixCache",
+           "PromptLookupProposer", "FaultPlan", "FaultError",
            "filtered_logits", "sample_tokens", "slot_keys",
            "verify_tokens"]

@@ -45,11 +45,29 @@ Everything per-request (sampling knobs, seeds, eos, budgets) is a
 per-slot ARRAY in the compiled program, so admission never recompiles;
 the only shape-churn axis is the prefill bucket, and those programs live
 in a bounded LRU (gluon.block.LRUTraceCache).
+
+ROBUSTNESS (docs/SERVING.md "Robustness"): step() is supervised — a
+dispatch exception no longer wedges the engine. The supervisor catches
+it, audits the page pool, rolls the implicated slots back (leases
+released, state parked), re-queues innocents with backoff, and
+quarantines a request whose dispatches fail `max_retries` times
+(terminal reason="error"). Requests carry deadlines (expired queued
+work is shed before admission; running work past deadline is cancelled
+at the next dispatch boundary) and priority classes; an attached
+SheddingPolicy (serving/policy.py) sheds or down-prioritizes work
+before it queues and latches graceful degradation under sustained
+overload. A re-queued, partially-decoded request restarts by
+prefilling prompt+emitted and resuming its RNG counter at the next
+token index — per-request streams are keyed (seed, token_index), so
+restarted outputs are bit-identical to an uninterrupted run.
 """
 from __future__ import annotations
 
+import inspect
 import itertools
 import time
+import weakref
+from collections import deque
 
 import numpy as np
 
@@ -64,11 +82,13 @@ from ..base import MXNetError
 from ..gluon.block import LRUTraceCache, _trace_channel
 from ..models.kv_cache import PagedKVCache
 from ..ndarray.ndarray import NDArray
+from ..telemetry import server as _tserver
 from ..telemetry import span
-from .page_pool import PagePool
+from .page_pool import PagePool, PagePoolExhausted
 from .prefix_cache import PrefixCache
 from .sampling import sample_tokens, slot_keys
-from .scheduler import Request, SlotScheduler
+from .scheduler import (QueueFullError, Request, ShedError,
+                        SlotScheduler)
 from .speculative import PromptLookupProposer, verify_tokens
 
 __all__ = ["ServingEngine"]
@@ -171,8 +191,44 @@ def _engine_metrics(eid):
                             "K-step decode block wall time", _E),
         "drain_seconds": h("serving_drain_seconds",
                            "serve(): last submit -> queue+slots empty", _E),
+        "dispatch_errors": c(
+            "serving_dispatch_errors_total",
+            "dispatch faults the engine supervisor caught (batch rolled "
+            "back, engine kept serving)", _E),
+        "dispatch_retries": c(
+            "serving_dispatch_retries_total",
+            "requests re-queued with backoff after a caught dispatch "
+            "fault or transient allocation failure", _E),
+        "requests_failed": c(
+            "serving_requests_failed_total",
+            "requests quarantined after max_retries failed dispatches "
+            "(terminal reason=\"error\")", _E),
+        "overload_level": g(
+            "serving_overload_level",
+            "shedding-policy assessment: 0 ok, 1 elevated, "
+            "2 overloaded", _E),
+        "degraded": g(
+            "serving_degraded",
+            "1 while the engine is gracefully degraded (speculation "
+            "suspended, /healthz flagged)", _E),
+        "retry_after": g(
+            "serving_retry_after_seconds",
+            "drain-rate estimate of when a rejected submission could "
+            "succeed (attached to shed / queue-full rejections)", _E),
     }
+    _shed_family()                  # registered per-process; children
     return {k: inst.labels(eid) for k, inst in m.items()}
+
+
+def _shed_family():
+    """The one three-label family: shed traffic split by reason AND the
+    shed request's priority class (aggregate reads stay cheap; the
+    split is what capacity debugging needs)."""
+    return telemetry.counter(
+        "serving_shed_total",
+        "requests shed by the robustness layer, by reason (queue_full, "
+        "overload, deadline, deadline_queued, deadline_running) and "
+        "priority class", ("engine", "reason", "priority"))
 
 
 class ServingEngine:
@@ -218,7 +274,9 @@ class ServingEngine:
                  decode_block=8, attn_impl="auto", prefill_bucket=None,
                  dtype=None, max_queue=None, prefix_cache=False,
                  prefix_cache_pages=None, speculative=False,
-                 spec_tokens=4, spec_max_ngram=3, spec_min_ngram=1):
+                 spec_tokens=4, spec_max_ngram=3, spec_min_ngram=1,
+                 num_priorities=3, policy=None, max_retries=3,
+                 retry_backoff_s=0.02, clock=None):
         self.model = model
         cfg = model.config
         self.num_slots = int(num_slots)
@@ -250,7 +308,23 @@ class ServingEngine:
             # drafter matches against — the request's OWN history only,
             # so drafting is schedule-independent
             self._hist = [None] * int(num_slots)
-        self.scheduler = SlotScheduler(num_slots, max_queue=max_queue)
+        self.scheduler = SlotScheduler(num_slots, max_queue=max_queue,
+                                       num_priorities=num_priorities)
+        # robustness layer (docs/SERVING.md "Robustness"): supervisor
+        # retry budget + backoff, optional shedding policy, and an
+        # injectable clock so deadline/backoff behavior is testable
+        # without wall-time races (the default IS perf_counter)
+        self.policy = policy
+        self.max_retries = int(max_retries)
+        if self.max_retries < 1:
+            raise MXNetError("max_retries must be >= 1")
+        self.retry_backoff_s = float(retry_backoff_s)
+        self._clock = clock if clock is not None else time.perf_counter
+        self._degraded = False
+        self._finish_times = deque(maxlen=64)   # drain-rate window
+        # extra lease rows audit_pages() should account for (the
+        # fault-injection harness registers pages it holds here)
+        self.audit_extra_leases = []
 
         self._params = list(model.collect_params().values())
         B = self.num_slots
@@ -325,6 +399,13 @@ class ServingEngine:
         self._eid = str(next(_engine_ids))
         self._metrics = _engine_metrics(self._eid)
         self._metrics["num_slots"].set(self.num_slots)
+        self._shed = _shed_family()
+        self._shed_children = {}   # (reason, priority) -> labeled child
+        self._shed_counts = {}     # same keys, host-side for stats
+        self._hook_kw_cache = None
+        # a collected engine must not leave /healthz stuck degraded
+        weakref.finalize(self, _tserver.clear_degraded,
+                         f"engine{self._eid}")
         self._evictions_seen = 0
         self._set_pool_gauges()
         # live introspection: /statusz shows this engine's config +
@@ -379,6 +460,12 @@ class ServingEngine:
             "pool_free_pages": int(m["pool_free_pages"].value),
             "queue_depth": int(m["queue_depth"].value),
             "slot_occupancy": int(m["slot_occupancy"].value),
+            "dispatch_errors": int(m["dispatch_errors"].value),
+            "dispatch_retries": int(m["dispatch_retries"].value),
+            "requests_failed": int(m["requests_failed"].value),
+            "overload_level": int(m["overload_level"].value),
+            "degraded": int(m["degraded"].value),
+            "shed": sum(self._shed_counts.values()),
         }
 
     def reset_stats(self):
@@ -386,8 +473,20 @@ class ServingEngine:
         rest of the registry are untouched)."""
         for inst in self._metrics.values():
             inst.reset()
+        for child in self._shed_children.values():
+            child.reset()
+        self._shed_counts = {}
         self._metrics["num_slots"].set(self.num_slots)
         self._set_pool_gauges()
+
+    def _shed_inc(self, reason, priority):
+        key = (reason, int(priority))
+        child = self._shed_children.get(key)
+        if child is None:
+            child = self._shed.labels(self._eid, reason, str(priority))
+            self._shed_children[key] = child
+        child.inc()
+        self._shed_counts[key] = self._shed_counts.get(key, 0) + 1
 
     def _set_load_gauges(self):
         self._metrics["queue_depth"].set(self.scheduler.num_queued)
@@ -443,10 +542,24 @@ class ServingEngine:
                 "spec_tokens": self.spec_tokens
                 if self.speculative else None,
                 "max_queue": self.scheduler.max_queue,
+                "num_priorities": self.scheduler.num_priorities,
+                "max_retries": self.max_retries,
+                "retry_backoff_s": self.retry_backoff_s,
                 "total_pages": self.page_pool.num_pages,
                 "steady_state": self._steady,
             },
             "admission_capacity": self.admission_capacity_estimate(),
+            "robustness": {
+                "degraded": self._degraded,
+                "overload_level": int(s["overload_level"]),
+                "policy": None if self.policy is None
+                else self.policy.snapshot(),
+                "shed": {f"{r}/p{p}": n
+                         for (r, p), n in sorted(self._shed_counts.items())},
+                "quarantined": int(s["requests_failed"]),
+                "dispatch_errors": int(s["dispatch_errors"]),
+                "retry_after_s": self.estimated_queue_wait(),
+            },
             "scheduler": self.scheduler.snapshot(),
             "prefix_hit_rate": s["prefix_hits"] / lookups
             if lookups else None,
@@ -463,7 +576,10 @@ class ServingEngine:
         progress = int(m["prefills"].value
                        + m["decode_dispatches"].value
                        + m["requests_finished"].value
-                       + m["requests_cancelled"].value)
+                       + m["requests_cancelled"].value
+                       + m["requests_failed"].value
+                       + m["dispatch_retries"].value
+                       + sum(self._shed_counts.values()))
         return progress, self.scheduler.has_work
 
     # -- device-cost accounting --------------------------------------------
@@ -529,12 +645,70 @@ class ServingEngine:
                 pc.num_pages * per_page)
         return out
 
+    # -- admission control -------------------------------------------------
+    def _drain_rate(self):
+        """Recent finishes per second (None until two finishes land in
+        the window) — the denominator of every retry-after estimate."""
+        ft = self._finish_times
+        if len(ft) < 2:
+            return None
+        dt = ft[-1] - ft[0]
+        if dt <= 0:
+            return None
+        return (len(ft) - 1) / dt
+
+    def estimated_queue_wait(self):
+        """Seconds until the current backlog would drain at the recent
+        finish rate — the retry-after estimate rejections carry and the
+        deadline-feasibility signal the shedding policy uses. None when
+        the engine has no recent drain history."""
+        rate = self._drain_rate()
+        if rate is None:
+            return None
+        return self.scheduler.num_queued / rate
+
+    def _reject(self, request, reason, cause=None):
+        """Common rejection tail: count, record the terminal timeline
+        with structured context, and raise (the scheduler's
+        QueueFullError enriched in place, or a fresh ShedError)."""
+        depth = self.scheduler.num_queued
+        active = self.scheduler.num_active
+        wait = self.estimated_queue_wait()
+        if wait is not None:
+            self._metrics["retry_after"].set(wait)
+        request.status = "shed"
+        self._metrics["requests_rejected"].inc()
+        self._shed_inc(reason, request.priority)
+        telemetry.request_log.terminal(
+            request.id, self._eid, "rejected", reason=reason,
+            priority=request.priority, prompt_len=request.prompt_len,
+            queue_depth=depth, active_slots=active,
+            retry_after_s=None if wait is None else round(wait, 4))
+        suffix = (f" [queue_depth={depth}, active_slots={active}"
+                  + (f", retry_after~{wait:.3f}s" if wait is not None
+                     else "") + "]")
+        if cause is not None:
+            telemetry.flight.note_queue_full(f"engine{self._eid}")
+            cause.queue_depth = depth
+            cause.active_slots = active
+            cause.retry_after_s = wait
+            cause.args = (str(cause.args[0]) + suffix,)
+            raise cause
+        telemetry.flight.note_shed(f"engine{self._eid}")
+        raise ShedError(
+            f"request {request.id} shed ({reason})" + suffix,
+            reason=reason, queue_depth=depth, active_slots=active,
+            retry_after_s=wait, priority=request.priority)
+
     # -- public API --------------------------------------------------------
     def submit(self, request):
         """Queue a Request (validated against this engine's capacity).
-        Rejections — over-long prompt, full admission queue — count into
-        serving_requests_rejected_total AND record a terminal `rejected`
-        timeline, so /requests shows rejected traffic too, then raise."""
+        Rejections — over-long prompt, full admission queue, policy
+        shed — count into serving_requests_rejected_total (sheds also
+        into serving_shed_total{reason,priority}) AND record a terminal
+        `rejected` timeline with queue depth / active slots / a
+        retry-after estimate, so /requests shows rejected traffic too,
+        then raise."""
         if request.prompt_len > self.max_length:
             self._metrics["requests_rejected"].inc()
             telemetry.request_log.terminal(
@@ -544,21 +718,28 @@ class ServingEngine:
             raise MXNetError(
                 f"prompt of {request.prompt_len} tokens exceeds slot "
                 f"capacity {self.max_length}")
-        request.t_submit = time.perf_counter()
+        now = self._clock()
+        request.t_submit = now
+        request.t_deadline = None if request.deadline_ms is None \
+            else now + request.deadline_ms / 1e3
         request.output_tokens = []
         request.token_times = []
+        request.dispatch_failures = 0
+        request.t_not_before = 0.0
+        if self.policy is not None:
+            action, reason = self.policy.on_submit(self, request, now)
+            if action == "shed":
+                self._reject(request, reason)
         try:
             out = self.scheduler.submit(request)
-        except MXNetError:
-            self._metrics["requests_rejected"].inc()
-            telemetry.request_log.terminal(
-                request.id, self._eid, "rejected", reason="queue_full",
-                prompt_len=request.prompt_len)
-            telemetry.flight.note_queue_full(f"engine{self._eid}")
-            raise
+        except QueueFullError as e:
+            self._reject(request, "queue_full", cause=e)
+        request.status = "queued"
         telemetry.request_log.begin(
             request.id, self._eid, prompt_len=request.prompt_len,
-            max_new_tokens=request.max_new_tokens)
+            max_new_tokens=request.max_new_tokens,
+            priority=request.priority,
+            deadline_ms=request.deadline_ms)
         self._metrics["queue_depth"].set(self.scheduler.num_queued)
         return out
 
@@ -575,7 +756,8 @@ class ServingEngine:
             if slot is None:
                 return None
             req = self._release_slot(slot)
-        req.t_finish = time.perf_counter()
+        req.t_finish = self._clock()
+        req.status = "cancelled"
         self._metrics["requests_cancelled"].inc()
         telemetry.request_log.end(
             request_id, self._eid, "cancelled",
@@ -589,35 +771,72 @@ class ServingEngine:
         return self.scheduler.has_work
 
     def step(self):
-        """One scheduling round: admit free slots (prefill), run one
-        K-step decode block, free finished slots. Returns the requests
-        that finished this round."""
-        if self.dispatch_hook is not None:
-            self.dispatch_hook(self)
+        """One SUPERVISED scheduling round: shed queued work past its
+        deadline, cancel running work past its deadline, admit free
+        slots (prefill), run one decode dispatch, free finished slots.
+
+        Dispatch exceptions do NOT propagate. The supervisor catches
+        them, runs the page-pool invariant audit, latches a
+        flight-recorder dump, rolls the implicated slots back (leases
+        released, device state parked), re-queues the requests with
+        backoff — and quarantines a request whose dispatches failed
+        `max_retries` times (terminal reason="error"). Rolled-back
+        requests restart by re-prefilling prompt+emitted with their RNG
+        counter resumed, so recovered outputs are bit-identical to an
+        uninterrupted run.
+
+        Returns every request that reached a TERMINAL state this round:
+        finished, deadline-shed/-cancelled, or quarantined."""
+        now = self._clock()
+        self._fire_hook("step")
         finished = []
-        for slot, req in self.scheduler.admit():
-            fin = self._admit(slot, req)
+        for req in self.scheduler.pop_expired(now):
+            finished.append(self._shed_expired(req))
+        for slot in list(self.scheduler.active_slots):
+            req = self.scheduler.request_at(slot)
+            if req.t_deadline is not None and now >= req.t_deadline:
+                finished.append(self._deadline_cancel(slot))
+        if self.policy is not None:
+            self.policy.on_step(self, now)
+        for slot, req in self.scheduler.admit(now):
+            try:
+                fin = self._admit(slot, req)
+            except Exception as e:          # noqa: BLE001 — supervisor
+                q = self._on_admit_fault(slot, req, e)
+                if q is not None:
+                    finished.append(q)
+                continue
             if fin is not None:
                 finished.append(fin)
         self._set_load_gauges()
         if self.scheduler.num_active:
-            finished.extend(self._decode_block())
+            try:
+                finished.extend(self._decode_block())
+            except Exception as e:          # noqa: BLE001 — supervisor
+                finished.extend(self._on_decode_fault(e))
             self._set_load_gauges()
         return finished
 
     def serve(self, requests=()):
         """Submit `requests`, run until the queue and all slots drain,
-        and return every finished request (submission order). Drain wall
-        time (last submit -> empty) lands in serving_drain_seconds."""
-        for r in requests:
-            self.submit(r)
-        t_drain0 = time.perf_counter()
+        and return every TERMINAL request (submission order) —
+        finished requests plus any shed, deadline-cancelled, or
+        quarantined along the way (check `.status`). Rejected
+        submissions raise out of submit() and are not returned. Drain
+        wall time (last submit -> empty) lands in
+        serving_drain_seconds."""
         done = []
+        for r in requests:
+            try:
+                self.submit(r)
+            except (QueueFullError, ShedError):
+                done.append(r)      # terminal: status == "shed"
+        t_drain0 = self._clock()
         with span("serving.drain", engine=self._eid):
             while self.has_work:
                 done.extend(self.step())
         self._metrics["drain_seconds"].observe(
-            time.perf_counter() - t_drain0)
+            self._clock() - t_drain0)
         done.sort(key=lambda r: r.t_submit)
         return done
 
@@ -628,6 +847,259 @@ class ServingEngine:
         by_id = {r.id: r for r in reqs}
         self.serve(reqs)
         return [by_id[r.id].output_tokens for r in reqs]
+
+    # -- dispatch hook ------------------------------------------------------
+    def _hook_takes_phase(self, hook):
+        """Legacy dispatch hooks take (engine) and fire once per step;
+        phase-aware hooks accept phase=/requests= keywords (or **kw)
+        and fire at every prefill/decode boundary too — the seam the
+        fault-injection harness (serving/faults.py) installs into.
+        Detected once per hook identity from its signature."""
+        cached = self._hook_kw_cache
+        if cached is not None and cached[0] is hook:
+            return cached[1]
+        try:
+            params = inspect.signature(hook).parameters
+            takes = any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                or name in ("phase", "requests")
+                for name, p in params.items())
+        except (TypeError, ValueError):
+            takes = False
+        self._hook_kw_cache = (hook, takes)
+        return takes
+
+    def _fire_hook(self, phase, requests=()):
+        hook = self.dispatch_hook
+        if hook is None:
+            return
+        if self._hook_takes_phase(hook):
+            hook(self, phase=phase, requests=tuple(requests))
+        elif phase == "step":
+            hook(self)
+
+    # -- graceful degradation ----------------------------------------------
+    def _set_degraded(self, on, reason="overload"):
+        """Latch / clear graceful degradation. While degraded the
+        engine suspends speculative decoding (wasted verify FLOPs are
+        pure loss when demand exceeds capacity — the plain decode
+        program serves until recovery), serving_degraded flips, and
+        /healthz reports the engine degraded."""
+        on = bool(on)
+        if on == self._degraded:
+            return
+        self._degraded = on
+        self._metrics["degraded"].set(int(on))
+        name = f"engine{self._eid}"
+        if on:
+            _tserver.set_degraded(name, reason)
+            telemetry.flight.record("degraded", engine=self._eid,
+                                    reason=reason)
+        else:
+            _tserver.clear_degraded(name)
+            telemetry.flight.record("recovered", engine=self._eid)
+
+    # -- deadline enforcement ----------------------------------------------
+    def _shed_expired(self, req):
+        """A queued request whose deadline passed before admission:
+        terminal `rejected(deadline)` — no tokens were produced, no
+        slot or page was ever touched."""
+        req.status = "shed"
+        req.t_finish = self._clock()
+        self._shed_inc("deadline_queued", req.priority)
+        telemetry.request_log.end(
+            req.id, self._eid, "rejected", reason="deadline",
+            queued=True, tokens=0)
+        return req
+
+    def _deadline_cancel(self, slot):
+        """A running request past its deadline, cancelled at the
+        dispatch boundary: slot and page leases released; the tokens
+        already emitted stay on the Request; terminal
+        `finished(deadline)`."""
+        req = self._release_slot(slot)
+        req.status = "deadline"
+        self._shed_inc("deadline_running", req.priority)
+        telemetry.request_log.end(
+            req.id, self._eid, "finished", reason="deadline",
+            tokens=len(req.output_tokens))
+        self._set_pool_gauges()
+        return req
+
+    # -- fault supervision --------------------------------------------------
+    def audit_pages(self, raise_on_error=False):
+        """Page-pool invariant audit with this engine's full lease map:
+        every mapped slot's table row, any extra lease rows registered
+        in `audit_extra_leases` (the fault-injection harness registers
+        pages it holds), and the prefix cache's member pages. Returns
+        the violation list ([] = clean)."""
+        leases = [self._table_host[s] for s in range(self.num_slots)
+                  if self._mapped[s]]
+        leases.extend(self.audit_extra_leases)
+        members = ()
+        if self.prefix_cache is not None:
+            members = np.nonzero(self.prefix_cache.member_mask())[0]
+        return self.page_pool.audit(leases=leases, members=members,
+                                    raise_on_error=raise_on_error)
+
+    def _audit_and_latch(self, phase, exc):
+        """Post-fault integrity check: run the page-pool audit while
+        the implicated slots still hold their leases (so the lease map
+        is complete) and latch a flight-recorder dump naming the
+        fault. Returns the violation list (normally empty — the fault
+        was caught BEFORE any accounting was rolled back)."""
+        violations = self.audit_pages()
+        detail = f"{phase}: {type(exc).__name__}: {exc}"
+        if violations:
+            detail += " | audit: " + "; ".join(violations)
+        telemetry.flight.record("dispatch_error", engine=self._eid,
+                                phase=phase, error=str(exc)[:200],
+                                audit_violations=len(violations))
+        telemetry.flight.trigger(
+            f"dispatch_error:engine{self._eid}", detail)
+        return violations
+
+    def _quarantine(self, req, error):
+        """Terminal failure: this request's dispatches failed
+        `max_retries` times — it is poison as far as the engine can
+        tell. Terminal `failed(error)`; the engine keeps serving
+        everyone else."""
+        req.status = "failed"
+        req.t_finish = self._clock()
+        self._metrics["requests_failed"].inc()
+        telemetry.request_log.end(
+            req.id, self._eid, "failed", reason="error",
+            failures=req.dispatch_failures, error=str(error)[:200],
+            tokens=len(req.output_tokens))
+        telemetry.flight.record("quarantined", engine=self._eid,
+                                request=req.id,
+                                failures=req.dispatch_failures)
+        return req
+
+    def _requeue(self, req, now, blamed, error=""):
+        """Roll one request back to the queue after a caught fault.
+        A `blamed` request carries the failure: exponential backoff,
+        probation (the scheduler re-tries it alone), quarantine at
+        max_retries. Innocents re-queue with one flat backoff tick and
+        no blame — their emitted tokens ride along and the restart
+        continuation keeps their output bit-identical. Returns the
+        quarantined Request when the retry budget is spent, else
+        None."""
+        if blamed:
+            req.dispatch_failures += 1
+            if req.dispatch_failures >= self.max_retries:
+                return self._quarantine(req, error)
+            backoff = self.retry_backoff_s * (
+                2 ** (req.dispatch_failures - 1))
+        else:
+            backoff = self.retry_backoff_s
+        req.t_not_before = now + backoff
+        self._metrics["dispatch_retries"].inc()
+        self.scheduler.requeue(req)
+        req.status = "queued"
+        telemetry.request_log.event(
+            req.id, self._eid, "requeued", blamed=blamed,
+            failures=req.dispatch_failures, backoff_s=round(backoff, 4))
+        return None
+
+    def _on_admit_fault(self, slot, req, exc):
+        """Supervise one failed admission: roll the slot fully back
+        (scheduler, page leases, parked device state) and re-queue the
+        request. Pool exhaustion is BACKPRESSURE — pages will drain, so
+        nobody is blamed and no dump is latched; anything else counts
+        against the request's retry budget. Returns the quarantined
+        Request, or None."""
+        now = self._clock()
+        self._metrics["dispatch_errors"].inc()
+        backpressure = isinstance(exc, PagePoolExhausted)
+        self.scheduler.release(slot)
+        self._free_slot_pages(slot)
+        self._done[slot] = True
+        self._remaining[slot] = 0
+        self._lengths[slot] = self.max_length
+        self._sync_slot(slot)
+        if not backpressure:
+            self._audit_and_latch("prefill", exc)
+        self._set_pool_gauges()
+        return self._requeue(req, now, blamed=not backpressure,
+                             error=str(exc))
+
+    def _on_decode_fault(self, exc):
+        """Supervise a failed decode dispatch: audit while the batch's
+        leases are still mapped, then roll every active slot back.
+        Blame assignment: when the batch held probationers (requests
+        with prior failures) only THEY are blamed — the scheduler
+        admits at most one probationer at a time, so repeat faults
+        converge on the poison request; a first fault (no history
+        anywhere) blames the whole batch, and a later clean dispatch
+        resets the innocents' counters. Returns the requests
+        quarantined by this fault."""
+        now = self._clock()
+        self._metrics["dispatch_errors"].inc()
+        self._audit_and_latch("decode", exc)
+        active = [(slot, self.scheduler.request_at(slot))
+                  for slot in self.scheduler.active_slots]
+        probationers = {id(r) for _, r in active
+                        if r.dispatch_failures > 0}
+        blame_all = not probationers
+        quarantined = []
+        # reversed + appendleft in requeue() restores admission order
+        for slot, req in reversed(active):
+            self._release_slot(slot)
+            q = self._requeue(
+                req, now,
+                blamed=blame_all or id(req) in probationers,
+                error=str(exc))
+            if q is not None:
+                quarantined.append(q)
+        self._set_pool_gauges()
+        return quarantined
+
+    def _scrub_slot_pages(self, slot):
+        """Zero the KV of the slot's EXCLUSIVE, non-tree pages (the
+        only pages a poisoned write can live in) before their leases
+        are released — a recycled page must not carry NaN residue into
+        the next owner's attention window, whatever the kernel's
+        masking does with out-of-range positions."""
+        if not self._mapped[slot]:
+            return
+        ref = self.page_pool.refcounts()
+        member = self.prefix_cache.member_mask() \
+            if self.prefix_cache is not None else None
+        pages = [int(p) for p in self._table_host[slot]
+                 if ref[int(p)] == 1
+                 and (member is None or not member[int(p)])]
+        if not pages:
+            return
+        idx = jnp.asarray(pages, jnp.int32)
+        zero = jnp.zeros((), self._kp.dtype)
+        self._kp = self._kp.at[:, idx].set(zero)
+        self._vp = self._vp.at[:, idx].set(zero)
+
+    def _on_bad_slots(self, bad, exc_msg):
+        """Slots whose dispatch produced non-finite logits (the
+        in-program finite guard): this dispatch's tokens for them are
+        already discarded by the caller; scrub their exclusive pages,
+        roll them back blamed, and latch a dump. Co-batched finite
+        slots keep their tokens — their state never mixed with the
+        poison. Returns the requests quarantined."""
+        now = self._clock()
+        self._metrics["dispatch_errors"].inc()
+        self._audit_and_latch("decode_nonfinite",
+                              MXNetError(exc_msg))
+        quarantined = []
+        for slot in reversed(bad):
+            req = self.scheduler.request_at(slot)
+            telemetry.request_log.event(
+                req.id, self._eid, "decode_discarded", slot=slot,
+                reason="nonfinite_logits")
+            self._scrub_slot_pages(slot)
+            self._release_slot(slot)
+            q = self._requeue(req, now, blamed=True, error=exc_msg)
+            if q is not None:
+                quarantined.append(q)
+        self._set_pool_gauges()
+        return quarantined
 
     # -- device-resident slot state ----------------------------------------
     def _build_slot_upload(self):
@@ -663,18 +1135,23 @@ class ServingEngine:
             lock |= self.prefix_cache.member_mask()
         return lock
 
-    def _map_slot_pages(self, slot, req):
-        """Page-table surgery for an admission: longest-prefix match,
-        CoW split when the whole prompt is cached, exclusive allocation
-        for the rest. Returns the prefix offset (tokens NOT recomputed;
-        prefill starts there)."""
+    def _map_slot_pages(self, slot, tokens):
+        """Page-table surgery for an admission (`tokens` = the ids the
+        slot must hold: the prompt, plus already-emitted tokens when a
+        rolled-back request restarts): longest-prefix match, CoW split
+        when the whole sequence is cached, exclusive allocation for the
+        rest. Returns the prefix offset (tokens NOT recomputed; prefill
+        starts there). On an allocation failure every lease taken by
+        the match is released before the exception propagates — a
+        faulted admission must not leak refcounts."""
         S, P = self.page_size, self._pages_per_slot
-        Tp = req.prompt_len
+        Tp = int(tokens.size)
         pc = self.prefix_cache
-        matched = pc.match(req.prompt) if pc is not None else []
+        matched = pc.match(tokens) if pc is not None else []
+        leased = list(matched)         # every lease match() took
         cow_src = None
         if matched and len(matched) * S >= Tp:
-            # Fully cached prompt (page-aligned): the last token must
+            # Fully cached sequence (page-aligned): the last token must
             # still run through the model for its logits, and that
             # rewrites the KV at position Tp-1 — INSIDE the last cached
             # page. Copy-on-write: re-home that page to an exclusive
@@ -682,9 +1159,14 @@ class ServingEngine:
             cow_src = matched.pop()
         n_shared = len(matched)
         need = P - n_shared
-        if pc is not None and self.page_pool.num_free < need:
-            pc.reclaim(need)           # LRU-evict idle cached prefixes
-        fresh = self.page_pool.alloc(need)
+        try:
+            if pc is not None and self.page_pool.num_free < need:
+                pc.reclaim(need)       # LRU-evict idle cached prefixes
+            fresh = self.page_pool.alloc(need)
+        except Exception:
+            if pc is not None and leased:
+                pc.release(leased)
+            raise
         if cow_src is not None:
             dst = fresh[0]             # lands at row index n_shared
             self._kp, self._vp = self._copy_page_fn(
@@ -719,7 +1201,7 @@ class ServingEngine:
         model, params = self.model, self._params
 
         def prefill(param_arrays, kp, vp, ids, row, offset, true_len,
-                    seed, temp, top_k, top_p, do_sample, eos):
+                    counter0, seed, temp, top_k, top_p, do_sample, eos):
             saved = [p._data for p in params]
             _trace_channel.push_frame()
             try:
@@ -739,7 +1221,11 @@ class ServingEngine:
                 for p, d in zip(params, saved):
                     p._data = d
             last = jnp.take(logits._data[0], true_len - 1, axis=0)
-            key = slot_keys(seed[None], jnp.zeros((1,), jnp.int32))
+            # the RNG stream is keyed (seed, token_index): counter0 is
+            # the index of the token this prefill samples — 0 for a
+            # fresh admission, len(output_tokens) for a rolled-back
+            # request restarting mid-generation (bit-identical resume)
+            key = slot_keys(seed[None], counter0[None])
             first = sample_tokens(last[None], key, do_sample[None],
                                   temp[None], top_k[None], top_p[None])[0]
             done0 = (first == eos) & (eos >= 0)
@@ -748,17 +1234,30 @@ class ServingEngine:
         return jax.jit(prefill, donate_argnums=(1, 2))
 
     def _admit(self, slot, req):
-        Tp = req.prompt_len
+        # restart continuation: a request rolled back after a caught
+        # fault already emitted `base` tokens — re-prefill the prompt
+        # PLUS those tokens and resume the RNG stream at token index
+        # `base`, making the recovered output bit-identical to an
+        # uninterrupted run (streams are keyed (seed, token_index))
+        base = len(req.output_tokens)
+        tokens = req.prompt if not base else np.concatenate(
+            [req.prompt, np.asarray(req.output_tokens, np.int32)])
+        Tp = int(tokens.size)
         telemetry.request_log.event(req.id, self._eid, "admitted",
                                     slot=slot)
-        offset = self._map_slot_pages(slot, req)
+        if base:
+            telemetry.request_log.event(
+                req.id, self._eid, "resumed", tokens=base)
+        self._fire_hook("prefill", (req,))
+        offset = self._map_slot_pages(slot, tokens)
+        req.status = "running"
         if self.prefix_cache is not None:
             telemetry.request_log.event(
                 req.id, self._eid, "prefix_match", cached_tokens=offset)
         suffix = Tp - offset
         Tb = self._bucket(suffix, offset)
         ids = np.zeros((1, Tb), np.int32)
-        ids[0, :suffix] = req.prompt[offset:]
+        ids[0, :suffix] = tokens[offset:]
         fn = self._prefill_programs.get(Tb)
         if fn is None:
             fn = self._wrap_program(self._build_prefill(Tb),
@@ -766,21 +1265,20 @@ class ServingEngine:
             self._prefill_programs[Tb] = fn
         param_datas = tuple(p.data()._data for p in self._params)
         i32 = lambda v: jnp.asarray(v, jnp.int32)  # noqa: E731
-        t0 = time.perf_counter()
+        t0 = self._clock()
         with span("serving.prefill", engine=self._eid, bucket=Tb,
                   cached_tokens=offset):
             kp, vp, first, done0 = fn(
                 param_datas, self._kp, self._vp, jnp.asarray(ids),
                 jnp.asarray(self._table_host[slot]), i32(offset),
-                i32(suffix), i32(req.seed),
+                i32(suffix), i32(base), i32(req.seed),
                 jnp.asarray(req.temperature, jnp.float32),
                 i32(req.top_k), jnp.asarray(req.top_p, jnp.float32),
                 jnp.asarray(req.do_sample), i32(
                     -1 if req.eos_token_id is None else req.eos_token_id))
             self._kp, self._vp = kp, vp
             first = int(first)      # host sync: the prefill is done here
-        now = time.perf_counter()
-        req.t_admit = now
+        now = self._clock()
         req.output_tokens.append(first)
         req.token_times.append(now)
         telemetry.request_log.event(
@@ -790,8 +1288,12 @@ class ServingEngine:
         m["prefills"].inc()
         m["prefill_tokens"].inc(suffix)
         m["tokens_emitted"].inc()
-        m["admission_wait"].observe(t0 - req.t_submit)
-        m["ttft"].observe(now - req.t_submit)
+        if not base:
+            # latency SLO metrics describe the FIRST admission only —
+            # a restart's wait is retry bookkeeping, not user TTFT
+            req.t_admit = now
+            m["admission_wait"].observe(t0 - req.t_submit)
+            m["ttft"].observe(now - req.t_submit)
         m["prefill_seconds"].observe(now - t0)
         self._account_flops(fn.program, now - t0)
         pc = self.prefix_cache
@@ -801,23 +1303,25 @@ class ServingEngine:
                 m["prefix_tokens_saved"].inc(offset)
             else:
                 m["prefix_misses"].inc()
-            # adopt the prompt's full pages into the radix tree: the
+            # adopt the PROMPT's full pages into the radix tree: the
             # next request sharing this prefix attaches instead of
             # recomputing (prefill is host-synced above, so the page
-            # contents are final)
-            n_full = Tp // self.page_size
+            # contents are final). On a restart the prompt still spans
+            # the same leading pages of the rebuilt table.
+            n_full = req.prompt_len // self.page_size
             if n_full:
                 pc.insert(req.prompt,
                           [int(p) for p in self._table_host[slot][:n_full]])
             self._set_pool_gauges()
         # budget: every decode step writes one KV; the last sampled token
-        # is never written, so a prompt of Tp supports up to
-        # max_length - Tp + 1 generated tokens
-        cap = min(req.max_new_tokens, self.max_length - Tp + 1)
+        # is never written, so a sequence of Tp supports up to
+        # max_length - Tp + 1 further generated tokens; `base` already
+        # spent that much of max_new_tokens
+        cap = min(req.max_new_tokens - base, self.max_length - Tp + 1)
         self._lengths[slot] = Tp
         self._cur_tok[slot] = first
         self._remaining[slot] = cap - 1
-        self._counters[slot] = 1
+        self._counters[slot] = base + 1
         self._seeds[slot] = req.seed
         self._temp[slot] = req.temperature
         self._top_k[slot] = req.top_k
@@ -829,31 +1333,31 @@ class ServingEngine:
         if self._done[slot]:
             return self._finish(slot)       # _release_slot syncs
         if self.speculative:
-            self._hist[slot] = list(req.prompt) + [first]
+            self._hist[slot] = list(tokens) + [first]
         self._sync_slot(slot)
         return None
 
     # -- decode ------------------------------------------------------------
-    def _decode_fn(self):
-        """The decode program for this dispatch: speculative or plain,
-        greedy-only (no sort/RNG in-program) when no active slot
-        samples. Both flavors are cached — at most two compiles per
-        mode, never per admission."""
+    def _decode_fn(self, spec):
+        """The decode program for this dispatch: speculative or plain
+        (`spec` — a degraded speculative engine dispatches the PLAIN
+        program until recovery), greedy-only (no sort/RNG in-program)
+        when no active slot samples. All flavors are cached — at most
+        two compiles per mode, never per admission."""
         greedy_only = not bool(
             self._do_sample[self.scheduler.active_slots].any())
-        key = (self.speculative, greedy_only)
+        key = (spec, greedy_only)
         fn = self._decode_programs.get(key)
         if fn is None:
             variant = "greedy" if greedy_only else "sampled"
             name = f"verify/S{self.spec_tokens}/{variant}" \
-                if self.speculative else f"decode/{variant}"
+                if spec else f"decode/{variant}"
             # the plain decode program scans K steps per dispatch and
             # XLA costs the scan body once — scale to per-dispatch
             fn = self._wrap_program(
-                self._build_spec_decode(greedy_only) if self.speculative
+                self._build_spec_decode(greedy_only) if spec
                 else self._build_decode(greedy_only), name,
-                cost_scale=1.0 if self.speculative
-                else float(self.decode_block))
+                cost_scale=1.0 if spec else float(self.decode_block))
             self._decode_programs[key] = fn
         return fn
 
@@ -874,19 +1378,26 @@ class ServingEngine:
 
                 def body(carry, _):
                     (kp, vp, lengths, cur_tok, done, remaining,
-                     counters) = carry
+                     counters, okc) = carry
                     active = (~done) & (remaining > 0)
                     cache = PagedKVCache(kp, vp, table, lengths,
                                          page_lock=lock, attn_impl=impl)
                     tok_in = jnp.where(active, cur_tok, 0)
                     logits, cache = model.forward(
                         NDArray(tok_in[:, None]), cache)
+                    step_logits = logits._data[:, -1, :]
+                    # in-program finite guard: a slot whose logits went
+                    # non-finite (corrupted KV, numeric blowup) is
+                    # flagged; the host discards its tokens from this
+                    # dispatch and re-prefills the request
+                    fin = jnp.isfinite(step_logits).all(axis=-1) \
+                        | ~active
                     if greedy_only:
-                        nxt = jnp.argmax(logits._data[:, -1, :],
+                        nxt = jnp.argmax(step_logits,
                                          axis=-1).astype(jnp.int32)
                     else:
                         keys = slot_keys(seeds, counters)
-                        nxt = sample_tokens(logits._data[:, -1, :], keys,
+                        nxt = sample_tokens(step_logits, keys,
                                             do_sample, temp, top_k,
                                             top_p)
                     new_len = jnp.where(active, cache.length, lengths)
@@ -897,11 +1408,12 @@ class ServingEngine:
                     carry = (cache.k_pages, cache.v_pages, new_len,
                              jnp.where(active, nxt, cur_tok), new_done,
                              new_rem,
-                             jnp.where(active, counters + 1, counters))
+                             jnp.where(active, counters + 1, counters),
+                             okc & fin)
                     return carry, (jnp.where(active, nxt, -1), active)
 
                 init = (kp, vp, lengths, cur_tok, done, remaining,
-                        counters)
+                        counters, jnp.ones_like(done))
                 final, (toks, valid) = lax.scan(body, init, None,
                                                 length=K)
             finally:
@@ -913,13 +1425,16 @@ class ServingEngine:
         return jax.jit(decode, donate_argnums=(1, 2))
 
     def _decode_block(self):
-        if self.speculative:
+        if self.speculative and not self._degraded:
             return self._spec_decode_block()
-        fn = self._decode_fn()
+        self._fire_hook("decode",
+                        [self.scheduler.request_at(s)
+                         for s in self.scheduler.active_slots])
+        fn = self._decode_fn(False)
         param_datas = tuple(p.data()._data for p in self._params)
         (lengths, cur_tok, done, remaining, counters, seeds, temp,
          top_k, top_p, do_sample, eos, table) = self._dstate
-        t0 = time.perf_counter()
+        t0 = self._clock()
         with span("serving.decode_block", engine=self._eid,
                   active=self.scheduler.num_active):
             out = fn(
@@ -927,7 +1442,7 @@ class ServingEngine:
                 lengths, cur_tok, done, remaining, counters, seeds,
                 temp, top_k, top_p, do_sample, eos)
             (self._kp, self._vp, lengths, cur_tok, done, remaining,
-             counters, toks, valid) = out
+             counters, okc, toks, valid) = out
             self._dstate = (lengths, cur_tok, done, remaining, counters,
                             seeds, temp, top_k, top_p, do_sample, eos,
                             table)
@@ -937,8 +1452,9 @@ class ServingEngine:
              self._counters) = (
                 np.array(lengths), np.array(cur_tok), np.array(done),
                 np.array(remaining), np.array(counters))
-            toks, valid = np.asarray(toks), np.asarray(valid)
-        now = time.perf_counter()
+            toks, valid, ok = (np.asarray(toks), np.asarray(valid),
+                               np.asarray(okc))
+        now = self._clock()
         dt = now - t0
         m = self._metrics
         m["decode_dispatches"].inc()
@@ -946,12 +1462,27 @@ class ServingEngine:
         m["decode_seconds"].observe(dt)
         rl = telemetry.request_log
         finished = []
+        bad = []
         n_emitted = 0
         for slot in self.scheduler.active_slots:
             req = self.scheduler.request_at(slot)
+            if not ok[slot]:
+                # non-finite logits: every token this dispatch sampled
+                # for the slot is garbage — discard them all, roll the
+                # request back (handled below, after accounting)
+                bad.append(slot)
+                continue
             emitted = toks[valid[:, slot], slot]
             req.output_tokens.extend(int(t) for t in emitted)
             req.token_times.extend([now] * emitted.size)
+            # a clean dispatch clears the request's failure history —
+            # probation is for consecutive faults, not per-lifetime
+            req.dispatch_failures = 0
+            req.t_not_before = 0.0
+            if self.speculative and self._hist[slot] is not None:
+                # degraded spec engine decoding plainly: keep the
+                # history current so speculation resumes seamlessly
+                self._hist[slot].extend(int(t) for t in emitted)
             if rl.enabled:
                 rl.event(req.id, self._eid, "decode", dur=dt,
                          tokens=int(emitted.size))
@@ -967,6 +1498,9 @@ class ServingEngine:
                 finished.append(self._finish(slot))
         m["tokens_emitted"].inc(n_emitted)
         self._account_flops(fn.program, dt)
+        if bad:
+            finished.extend(self._on_bad_slots(
+                bad, "non-finite logits in decode dispatch"))
         return finished
 
     # -- speculative decode ------------------------------------------------
@@ -997,6 +1531,10 @@ class ServingEngine:
                     [jnp.where(active, cur_tok, 0)[:, None],
                      jnp.where(active[:, None], drafts, 0)], axis=1)
                 logits, cache = model.forward(NDArray(toks_in), cache)
+                # in-program finite guard (see _build_decode): flag any
+                # slot whose verification logits went non-finite
+                ok = jnp.isfinite(logits._data).all(axis=(1, 2)) \
+                    | ~active
                 emitted, n_acc = verify_tokens(
                     logits._data, drafts, nd, seeds, counters,
                     do_sample, temp, top_k, top_p,
@@ -1030,12 +1568,16 @@ class ServingEngine:
                 for p, d in zip(params, saved):
                     p._data = d
             return (cache.k_pages, cache.v_pages, new_len, new_cur,
-                    new_done, new_rem, new_cnt, toks, n_em, n_acc_em)
+                    new_done, new_rem, new_cnt, ok, toks, n_em,
+                    n_acc_em)
 
         return jax.jit(decode, donate_argnums=(1, 2))
 
     def _spec_decode_block(self):
-        fn = self._decode_fn()
+        self._fire_hook("decode",
+                        [self.scheduler.request_at(s)
+                         for s in self.scheduler.active_slots])
+        fn = self._decode_fn(True)
         B, S = self.num_slots, self.spec_tokens
         drafts = np.zeros((B, S - 1), np.int32)
         n_draft = np.zeros(B, np.int32)
@@ -1046,7 +1588,7 @@ class ServingEngine:
         param_datas = tuple(p.data()._data for p in self._params)
         (lengths, cur_tok, done, remaining, counters, seeds, temp,
          top_k, top_p, do_sample, eos, table) = self._dstate
-        t0 = time.perf_counter()
+        t0 = self._clock()
         with span("serving.spec_decode", engine=self._eid,
                   active=self.scheduler.num_active,
                   drafted=int(n_draft.sum())):
@@ -1056,7 +1598,7 @@ class ServingEngine:
                 jnp.asarray(drafts), jnp.asarray(n_draft), seeds, temp,
                 top_k, top_p, do_sample, eos)
             (self._kp, self._vp, lengths, cur_tok, done, remaining,
-             counters, toks, n_em, n_acc) = out
+             counters, okc, toks, n_em, n_acc) = out
             self._dstate = (lengths, cur_tok, done, remaining, counters,
                             seeds, temp, top_k, top_p, do_sample, eos,
                             table)
@@ -1064,9 +1606,10 @@ class ServingEngine:
              self._counters) = (
                 np.array(lengths), np.array(cur_tok), np.array(done),
                 np.array(remaining), np.array(counters))
-            toks, n_em, n_acc = (np.asarray(toks), np.asarray(n_em),
-                                 np.asarray(n_acc))
-        now = time.perf_counter()
+            toks, n_em, n_acc, ok = (np.asarray(toks), np.asarray(n_em),
+                                     np.asarray(n_acc),
+                                     np.asarray(okc))
+        now = self._clock()
         dt = now - t0
         m = self._metrics
         m["decode_dispatches"].inc()
@@ -1074,14 +1617,20 @@ class ServingEngine:
         m["decode_seconds"].observe(dt)
         rl = telemetry.request_log
         finished = []
+        bad = []
         n_emitted = 0
         accepted = 0
         for slot in self.scheduler.active_slots:
             req = self.scheduler.request_at(slot)
+            if not ok[slot]:
+                bad.append(slot)
+                continue
             n = int(n_em[slot])
             emitted = [int(t) for t in toks[slot, :n]]
             req.output_tokens.extend(emitted)
             req.token_times.extend([now] * n)
+            req.dispatch_failures = 0
+            req.t_not_before = 0.0
             if rl.enabled:
                 rl.event(req.id, self._eid, "verify", dur=dt,
                          drafted=int(n_draft[slot]),
@@ -1105,6 +1654,9 @@ class ServingEngine:
         self._account_flops(
             fn.program, dt,
             wasted_fraction=(drafted - accepted) / (B * S))
+        if bad:
+            finished.extend(self._on_bad_slots(
+                bad, "non-finite logits in verification dispatch"))
         return finished
 
     def _release_slot(self, slot):
@@ -1112,7 +1664,7 @@ class ServingEngine:
         to the pool, page leases released, in-program writes parked OOB
         (length = max_length) so the recycled pages can't be touched."""
         req = self.scheduler.release(slot)
-        req.t_finish = time.perf_counter()
+        req.t_finish = self._clock()
         self._done[slot] = True
         self._remaining[slot] = 0
         self._lengths[slot] = self.max_length
@@ -1127,6 +1679,8 @@ class ServingEngine:
         # budget exhaustion leaves remaining <= 0, eos leaves budget
         reason = "budget" if self._remaining[slot] <= 0 else "eos"
         req = self._release_slot(slot)
+        req.status = "finished"
+        self._finish_times.append(self._clock())   # drain-rate window
         self._metrics["requests_finished"].inc()
         telemetry.request_log.end(
             req.id, self._eid, "finished", reason=reason,

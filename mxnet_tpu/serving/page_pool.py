@@ -37,7 +37,14 @@ import numpy as np
 
 from ..base import MXNetError
 
-__all__ = ["PagePool"]
+__all__ = ["PagePool", "PagePoolExhausted"]
+
+
+class PagePoolExhausted(MXNetError):
+    """alloc() could not satisfy the request. A distinct type because
+    the engine supervisor treats exhaustion as BACKPRESSURE (requeue
+    the admission and retry once pages drain — nobody's fault), not as
+    a dispatch fault that blames the request."""
 
 
 class PagePool:
@@ -93,7 +100,7 @@ class PagePool:
         if n < 0:
             raise MXNetError("alloc(n) needs n >= 0")
         if n > len(self._free):
-            raise MXNetError(
+            raise PagePoolExhausted(
                 f"page pool exhausted: want {n} pages, {len(self._free)} "
                 f"free of {self.num_pages} (evict cached prefixes or "
                 "grow prefix_cache_pages)")
@@ -157,6 +164,73 @@ class PagePool:
         (dst,) = self.alloc(1)
         self.decref([page])
         return dst, True
+
+    def audit(self, leases=None, members=(), raise_on_error=False):
+        """O(pages) invariant check — the supervisor runs this after
+        every caught dispatch fault, and tests run it at drain.
+
+        leases: optional iterable of page-id rows (one per mapped slot
+        table). Tree membership adds no refcount (the prefix cache
+        parks idle pages at refcount 0), so when leases are given,
+        refcount == slot-lease count must hold exactly for every
+        allocated page, and an allocated page with refcount 0 must be
+        a tree member — anything else is a leaked page.
+        members: page ids the prefix-cache radix tree owns.
+
+        Returns the list of violation strings ([] = clean); with
+        raise_on_error=True a non-empty list raises MXNetError instead.
+        """
+        v = []
+        free = list(self._free)
+        free_set = set(free)
+        if len(free) != len(free_set):
+            v.append(f"free list holds duplicates "
+                     f"({len(free) - len(free_set)})")
+        members = set(int(p) for p in members)
+        for p in free_set:
+            if not 0 <= p < self.num_pages:
+                v.append(f"free list holds out-of-range page {p}")
+        for p in range(self.num_pages):
+            alloc = bool(self._allocated[p])
+            ref = int(self._refcount[p])
+            if alloc == (p in free_set):
+                v.append(f"page {p}: allocated={alloc} but "
+                         f"{'in' if p in free_set else 'not in'} "
+                         "free list")
+            if ref < 0:
+                v.append(f"page {p}: negative refcount {ref}")
+            if ref > 0 and not alloc:
+                v.append(f"page {p}: refcount {ref} on free page")
+            if p in members and not alloc:
+                v.append(f"page {p}: tree member but not allocated")
+        if leases is not None:
+            lease_count = np.zeros(self.num_pages, np.int64)
+            for row in leases:
+                for p in row:
+                    p = int(p)
+                    if not 0 <= p < self.num_pages:
+                        v.append(f"slot table references out-of-range "
+                                 f"page {p}")
+                        continue
+                    lease_count[p] += 1
+            for p in range(self.num_pages):
+                ref = int(self._refcount[p])
+                n = int(lease_count[p])
+                if n and p in free_set:
+                    v.append(f"page {p}: {n} slot lease(s) on a free "
+                             "page")
+                    continue
+                if not self._allocated[p]:
+                    continue
+                if ref != n:
+                    v.append(f"page {p}: refcount {ref} != {n} slot "
+                             "lease(s)")
+                if ref == 0 and n == 0 and p not in members:
+                    v.append(f"page {p}: allocated with no lease and "
+                             "no tree membership (leaked)")
+        if v and raise_on_error:
+            raise MXNetError("page pool audit failed: " + "; ".join(v))
+        return v
 
     def __repr__(self):
         return (f"PagePool(pages={self.num_pages}, free={self.num_free}, "

@@ -1,0 +1,167 @@
+"""SLO-aware admission and load-shedding policy.
+
+PR 5/6 built the control SIGNALS — queue-depth and TTFT gauges, the
+admission-capacity estimate, the flight recorder. This module closes
+the loop: a `SheddingPolicy` attached to a `ServingEngine`
+(``ServingEngine(..., policy=SheddingPolicy(...))``) reads those live
+signals and decides, BEFORE a request queues, whether to admit it,
+down-prioritize it, or shed it — and, under sustained overload, flips
+the engine into graceful degradation.
+
+Overload levels (assessed from live telemetry on every submit and
+every step):
+
+  * 0 OK        — queue below the low watermark, TTFT inside the SLO.
+  * 1 ELEVATED  — queue at/above the low watermark, or the recent TTFT
+                  p99 is past `ttft_slo_ms`, or requests are queued
+                  with zero admission-capacity headroom. New
+                  default-priority work is DOWN-PRIORITIZED one class
+                  (interactive class-0 traffic is untouched).
+  * 2 OVERLOADED — queue at/above the high watermark (or TTFT blown
+                  with a backlog). Everything below the protected
+                  priority floor is SHED at submit with
+                  `ShedError(reason="overload")`; deadline-infeasible
+                  requests (the drain-rate estimate says they cannot
+                  start in time) are shed with reason="deadline".
+
+Degradation: `degrade_after` consecutive overloaded steps latch the
+engine degraded — speculative decoding is suspended (wasted verify
+FLOPs are pure loss when demand exceeds capacity; the engine falls
+back to the plain decode program and re-enables speculation on
+recovery), `serving_degraded`/`/healthz` flip, and a breadcrumb lands
+in the flight ring. `recover_after` consecutive non-overloaded steps
+clear it. All thresholds default from engine shape (watermarks at
+1x/2x num_slots) so `SheddingPolicy()` is usable as-is.
+
+The policy is pure host arithmetic over a handful of counters — its
+in-path cost is bounded by the <2% A/B budget the overload bench
+(`bench.py gpt2_serving_overload`) measures.
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = ["SheddingPolicy"]
+
+
+class SheddingPolicy:
+    """Telemetry-driven admission control for one ServingEngine.
+
+    ttft_slo_ms: recent TTFT p99 past this marks the engine elevated
+        (None disables the TTFT signal).
+    queue_low / queue_high: queued-request watermarks for elevated /
+        overloaded (defaults: num_slots / 2*num_slots at attach time).
+    shed_priority_floor: classes <= this are never shed by overload
+        (deadline-infeasible shedding still applies; default 0 keeps
+        only the interactive class protected).
+    min_ttft_samples: TTFT observations required before the p99 signal
+        is trusted.
+    deadline_headroom: shed a request whose deadline budget is below
+        headroom x estimated queue wait (drain-rate based; only while
+        elevated or worse — the estimate is noise when idle).
+    degrade_after / recover_after: consecutive step ticks at/below
+        level 2 that latch / clear graceful degradation.
+    """
+
+    def __init__(self, ttft_slo_ms=None, queue_low=None, queue_high=None,
+                 shed_priority_floor=0, min_ttft_samples=8,
+                 deadline_headroom=1.0, degrade_after=3,
+                 recover_after=6):
+        self.ttft_slo_ms = ttft_slo_ms
+        self.queue_low = queue_low
+        self.queue_high = queue_high
+        self.shed_priority_floor = int(shed_priority_floor)
+        self.min_ttft_samples = int(min_ttft_samples)
+        self.deadline_headroom = float(deadline_headroom)
+        self.degrade_after = int(degrade_after)
+        self.recover_after = int(recover_after)
+        self._hot = 0              # consecutive overloaded ticks
+        self._cool = 0             # consecutive non-overloaded ticks
+        self.level = 0
+        self.downgrades = 0
+
+    # -- signals -----------------------------------------------------------
+    def _watermarks(self, engine):
+        low = self.queue_low if self.queue_low is not None \
+            else engine.num_slots
+        high = self.queue_high if self.queue_high is not None \
+            else 2 * engine.num_slots
+        return max(1, int(low)), max(2, int(high))
+
+    def _ttft_blown(self, engine):
+        if self.ttft_slo_ms is None:
+            return False
+        h = engine._metrics["ttft"]
+        if h.count < self.min_ttft_samples:
+            return False
+        p99 = h.percentile(99)
+        return (not math.isnan(p99)) and p99 * 1e3 > self.ttft_slo_ms
+
+    def assess(self, engine):
+        """Current overload level from live telemetry (also stored on
+        `.level` and published as serving_overload_level)."""
+        q = engine.scheduler.num_queued
+        low, high = self._watermarks(engine)
+        ttft_blown = self._ttft_blown(engine)
+        if q >= high or (ttft_blown and q >= low):
+            level = 2
+        elif q >= low or ttft_blown or (
+                q > 0 and engine.admission_capacity_estimate()
+                <= engine.scheduler.num_active):
+            level = 1
+        else:
+            level = 0
+        self.level = level
+        engine._metrics["overload_level"].set(level)
+        return level
+
+    # -- hooks the engine calls --------------------------------------------
+    def on_submit(self, engine, request, now):
+        """Admission decision for one request, BEFORE it queues.
+        Returns (action, reason): ("admit", None), ("downgrade", ...)
+        — request.priority already bumped — or ("shed", reason)."""
+        level = self.assess(engine)
+        if level >= 2 and request.priority > self.shed_priority_floor:
+            return "shed", "overload"
+        if level >= 1 and request.deadline_ms is not None:
+            wait = engine.estimated_queue_wait()
+            if wait is not None and request.deadline_ms / 1e3 \
+                    < self.deadline_headroom * wait:
+                return "shed", "deadline"
+        if level >= 1 and request.priority >= 1 \
+                and request.priority < engine.scheduler.num_priorities - 1:
+            request.priority += 1
+            self.downgrades += 1
+            return "downgrade", "elevated"
+        return "admit", None
+
+    def on_step(self, engine, now):
+        """Per-step degradation tick: latch after `degrade_after`
+        consecutive overloaded assessments, clear after
+        `recover_after` calm ones."""
+        level = self.assess(engine)
+        if level >= 2:
+            self._hot += 1
+            self._cool = 0
+            if self._hot >= self.degrade_after:
+                engine._set_degraded(True, "overload")
+        else:
+            self._cool += 1
+            self._hot = 0
+            if self._cool >= self.recover_after:
+                engine._set_degraded(False)
+        return level
+
+    def snapshot(self):
+        """JSON-able config+state for /statusz and flight dumps."""
+        return {
+            "ttft_slo_ms": self.ttft_slo_ms,
+            "queue_low": self.queue_low,
+            "queue_high": self.queue_high,
+            "shed_priority_floor": self.shed_priority_floor,
+            "deadline_headroom": self.deadline_headroom,
+            "degrade_after": self.degrade_after,
+            "recover_after": self.recover_after,
+            "level": self.level,
+            "downgrades": self.downgrades,
+        }

@@ -2,12 +2,28 @@
 
 The engine decodes a FIXED batch of B slots (one compiled program, no
 shape churn); the scheduler owns which request occupies which slot.
-Admission is strict FIFO — the oldest queued request always gets the
-next free slot, so a steady stream of new arrivals can never starve an
-earlier one. Slots free the moment their request finishes (eos or token
-budget), and a freed slot is re-admittable between two compiled decode
-dispatches — the continuous-batching property: a finished sequence
-never burns its slot waiting for the slowest member of its batch.
+
+Admission order (docs/SERVING.md "Robustness"):
+
+  * Requests carry a PRIORITY CLASS (0 = most urgent). Each class has
+    its own FIFO queue with an independent bound, so bulk traffic can
+    never push interactive traffic out of the admission queue.
+  * Within the pick loop the highest class goes first, but every
+    `aging_every`-th admission takes the globally OLDEST eligible
+    request regardless of class — deterministic aging, so a steady
+    stream of high-priority arrivals can never starve a queued
+    low-priority request (starvation-freedom is tested, not assumed).
+  * Requests re-queued by the engine supervisor after a dispatch fault
+    sit out their backoff window (`t_not_before`) and then re-enter at
+    the FRONT of their class (they are older than anything queued
+    behind them). A request with a failure history is on PROBATION:
+    at most one probationer is in flight at a time, so a poison request
+    gets re-tried alone and can never take innocents down twice.
+
+Slots free the moment their request finishes (eos or token budget), and
+a freed slot is re-admittable between two compiled decode dispatches —
+the continuous-batching property: a finished sequence never burns its
+slot waiting for the slowest member of its batch.
 """
 from __future__ import annotations
 
@@ -18,9 +34,11 @@ import numpy as np
 
 from ..base import MXNetError
 
-__all__ = ["Request", "SlotScheduler", "QueueFullError"]
+__all__ = ["Request", "SlotScheduler", "RejectedError", "QueueFullError",
+           "ShedError"]
 
 _req_counter = itertools.count()
+_seq_counter = itertools.count()
 
 
 class Request:
@@ -31,11 +49,19 @@ class Request:
     per-request and dynamic — they never recompile the engine. seed
     drives this request's private RNG stream (see serving.sampling).
     eos_token_id=None disables eos stopping for this request.
+
+    priority: admission class, 0 = most urgent (clamped by the
+    scheduler to its configured class count; default 1 = normal).
+    deadline_ms: end-to-end budget relative to submit(). A queued
+    request past its deadline is shed before admission (terminal
+    `rejected(deadline)`); a running one is cancelled at the next
+    dispatch boundary (terminal `finished(deadline)`, partial output
+    kept). None = no deadline.
     """
 
     def __init__(self, prompt, max_new_tokens, request_id=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                 seed=0, eos_token_id=None):
+                 seed=0, eos_token_id=None, priority=1, deadline_ms=None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size == 0:
             raise MXNetError("Request needs a non-empty prompt")
@@ -44,6 +70,8 @@ class Request:
         if temperature <= 0:
             raise MXNetError("temperature must be > 0 (use "
                              "do_sample=False for greedy)")
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise MXNetError("deadline_ms must be > 0 (or None)")
         self.max_new_tokens = int(max_new_tokens)
         self.id = request_id if request_id is not None \
             else next(_req_counter)
@@ -53,11 +81,24 @@ class Request:
         self.top_p = float(top_p if top_p is not None else 1.0)
         self.seed = int(seed)
         self.eos_token_id = eos_token_id
+        self.priority = int(priority)
+        if self.priority < 0:
+            raise MXNetError("priority must be >= 0 (0 = most urgent)")
+        self.deadline_ms = None if deadline_ms is None \
+            else float(deadline_ms)
         # filled in by the engine
+        self.status = "new"
         self.output_tokens = []
         self.t_submit = None
         self.t_admit = None
         self.t_finish = None
+        self.t_deadline = None       # absolute, engine clock domain
+        # supervisor bookkeeping (serving/engine.py): consecutive
+        # dispatch failures blamed on this request, and the earliest
+        # clock time it may be re-admitted after a faulted dispatch
+        self.dispatch_failures = 0
+        self.t_not_before = 0.0
+        self._seq = None             # global submit order, set by submit()
 
     @property
     def prompt_len(self):
@@ -66,51 +107,177 @@ class Request:
     def __repr__(self):
         return (f"Request(id={self.id}, prompt_len={self.prompt_len}, "
                 f"max_new={self.max_new_tokens}, "
+                f"priority={self.priority}, "
                 f"generated={len(self.output_tokens)})")
 
 
-class QueueFullError(MXNetError):
-    """Raised by SlotScheduler.submit when the bounded admission queue is
-    at capacity — the engine counts these as rejected submissions
-    (serving_requests_rejected_total) before re-raising."""
+class RejectedError(MXNetError):
+    """A submission the serving stack refused. Carries structured
+    context so a front-end can do better than parse the message:
+    `reason`, `queue_depth`, `active_slots`, `priority`, and
+    `retry_after_s` (drain-rate estimate of when retrying could
+    succeed; None when the engine has no recent finishes to rate)."""
+
+    def __init__(self, msg, reason=None, queue_depth=None,
+                 active_slots=None, retry_after_s=None, priority=None):
+        super().__init__(msg)
+        self.reason = reason
+        self.queue_depth = queue_depth
+        self.active_slots = active_slots
+        self.retry_after_s = retry_after_s
+        self.priority = priority
+
+
+class QueueFullError(RejectedError):
+    """Raised by SlotScheduler.submit when the request's priority-class
+    queue is at capacity — the engine counts these as rejected
+    submissions (serving_requests_rejected_total and
+    serving_shed_total{reason="queue_full"}) before re-raising with a
+    retry-after estimate attached."""
+
+
+class ShedError(RejectedError):
+    """Raised by the engine when the shedding policy refuses a request
+    before it queues (overload, infeasible deadline) — counted in
+    serving_shed_total{reason,priority}."""
 
 
 class SlotScheduler:
-    """Fixed-pool slot allocator + FIFO admission queue.
+    """Fixed-pool slot allocator + priority-class admission queues.
 
-    max_queue bounds the admission queue (None = unbounded): a serving
-    front-end needs backpressure it can see — an unbounded queue turns
-    overload into silent tail-latency collapse instead of a countable
-    rejection."""
+    max_queue bounds each class's queue (None = unbounded; a sequence
+    gives per-class bounds): a serving front-end needs backpressure it
+    can see — an unbounded queue turns overload into silent tail-latency
+    collapse instead of a countable rejection. num_priorities is the
+    class count (requests clamp into it); aging_every sets the
+    starvation-freedom cadence (every Nth admission is oldest-first)."""
 
-    def __init__(self, num_slots, max_queue=None):
+    def __init__(self, num_slots, max_queue=None, num_priorities=3,
+                 aging_every=4):
         if num_slots < 1:
             raise MXNetError("need at least one decode slot")
         self.num_slots = int(num_slots)
-        self.max_queue = None if max_queue is None else int(max_queue)
-        if self.max_queue is not None and self.max_queue < 1:
-            raise MXNetError("max_queue must be >= 1 (or None)")
+        self.num_priorities = int(num_priorities)
+        if self.num_priorities < 1:
+            raise MXNetError("num_priorities must be >= 1")
+        self.aging_every = int(aging_every)
+        if self.aging_every < 2:
+            raise MXNetError("aging_every must be >= 2")
+        if max_queue is None or np.isscalar(max_queue):
+            bound = None if max_queue is None else int(max_queue)
+            if bound is not None and bound < 1:
+                raise MXNetError("max_queue must be >= 1 (or None)")
+            self._bounds = [bound] * self.num_priorities
+        else:
+            self._bounds = [None if b is None else int(b)
+                            for b in max_queue]
+            if len(self._bounds) != self.num_priorities:
+                raise MXNetError(
+                    f"per-class max_queue needs {self.num_priorities} "
+                    f"entries, got {len(self._bounds)}")
+            if any(b is not None and b < 1 for b in self._bounds):
+                raise MXNetError("per-class max_queue bounds must be "
+                                 ">= 1 (or None)")
         self._free = deque(range(self.num_slots))
-        self._queue = deque()
+        self._queues = [deque() for _ in range(self.num_priorities)]
         self._active = {}          # slot -> Request
+        self._admitted = 0         # total admissions, drives aging
+
+    @property
+    def max_queue(self):
+        """The scalar bound when all classes share one (the common,
+        back-compatible configuration), else the per-class list."""
+        first = self._bounds[0]
+        if all(b == first for b in self._bounds):
+            return first
+        return list(self._bounds)
 
     # -- queue -------------------------------------------------------------
     def submit(self, request):
-        if self.max_queue is not None and len(self._queue) >= self.max_queue:
+        pr = min(max(int(getattr(request, "priority", 1)), 0),
+                 self.num_priorities - 1)
+        request.priority = pr
+        bound = self._bounds[pr]
+        if bound is not None and len(self._queues[pr]) >= bound:
             raise QueueFullError(
-                f"admission queue full ({self.max_queue} waiting); "
-                "rejecting request — retry after the queue drains")
-        self._queue.append(request)
+                f"admission queue full for priority class {pr} "
+                f"({bound} waiting); rejecting request — retry after "
+                "the queue drains",
+                reason="queue_full", queue_depth=self.num_queued,
+                active_slots=self.num_active, priority=pr)
+        request._seq = next(_seq_counter)
+        self._queues[pr].append(request)
         return request
 
-    def admit(self):
-        """Pair queued requests with free slots, oldest request first.
+    def requeue(self, request):
+        """Put a request the engine rolled back (faulted dispatch,
+        transient allocation failure) back at the FRONT of its class —
+        it is older than everything queued behind it. Class bounds do
+        not apply: the request was already admitted once."""
+        self._queues[request.priority].appendleft(request)
+        return request
+
+    def pop_expired(self, now):
+        """Remove and return every queued request whose deadline has
+        passed — the engine sheds these before admission."""
+        out = []
+        for q in self._queues:
+            survivors = [r for r in q
+                         if r.t_deadline is None or now < r.t_deadline]
+            if len(survivors) != len(q):
+                out.extend(r for r in q
+                           if r.t_deadline is not None
+                           and now >= r.t_deadline)
+                q.clear()
+                q.extend(survivors)
+        return out
+
+    def _eligible(self, req, now, probe_ok):
+        if req.dispatch_failures > 0 and not probe_ok:
+            return False             # one probationer in flight at a time
+        if now is not None and req.t_not_before > now:
+            return False             # still backing off
+        return True
+
+    def _pick(self, now):
+        probe_ok = not any(r.dispatch_failures > 0
+                           for r in self._active.values())
+        if (self._admitted + 1) % self.aging_every == 0:
+            # aging turn: globally oldest eligible request wins,
+            # whatever its class
+            best = None
+            for ci, q in enumerate(self._queues):
+                for pos, req in enumerate(q):
+                    if self._eligible(req, now, probe_ok) and (
+                            best is None or req._seq < best[0]):
+                        best = (req._seq, ci, pos)
+            if best is not None:
+                _, ci, pos = best
+                req = self._queues[ci][pos]
+                del self._queues[ci][pos]
+                return req
+            return None
+        for q in self._queues:
+            for pos, req in enumerate(q):
+                if self._eligible(req, now, probe_ok):
+                    del q[pos]
+                    return req
+        return None
+
+    def admit(self, now=None):
+        """Pair queued requests with free slots: highest priority class
+        first, FIFO within a class, with the aging and probation rules
+        described in the module docstring. `now` (the engine's clock)
+        activates backoff windows; None admits regardless of backoff.
         Returns the [(slot, request), ...] admitted this round."""
         admitted = []
-        while self._free and self._queue:
+        while self._free:
+            req = self._pick(now)
+            if req is None:
+                break
             slot = self._free.popleft()
-            req = self._queue.popleft()
             self._active[slot] = req
+            self._admitted += 1
             admitted.append((slot, req))
         return admitted
 
@@ -123,13 +290,14 @@ class SlotScheduler:
         return req
 
     def cancel_queued(self, request_id):
-        """Remove a not-yet-admitted request from the queue by id.
+        """Remove a not-yet-admitted request from its queue by id.
         Returns the Request, or None when no queued request matches
         (it may already be running — see slot_of)."""
-        for i, req in enumerate(self._queue):
-            if req.id == request_id:
-                del self._queue[i]
-                return req
+        for q in self._queues:
+            for i, req in enumerate(q):
+                if req.id == request_id:
+                    del q[i]
+                    return req
         return None
 
     def slot_of(self, request_id):
@@ -143,26 +311,35 @@ class SlotScheduler:
     def request_at(self, slot):
         return self._active.get(slot)
 
+    def queued_requests(self):
+        """Queued requests, admission-priority order (class, then FIFO)."""
+        return [r for q in self._queues for r in q]
+
     @property
     def queued_ids(self):
-        """Request ids waiting for a slot, admission order."""
-        return [r.id for r in self._queue]
+        """Request ids waiting for a slot, admission-priority order."""
+        return [r.id for r in self.queued_requests()]
 
     def snapshot(self):
         """JSON-able view of the scheduler's state — what /statusz and
         the flight recorder's state.json embed: the slot map (slot →
-        request id + progress), the waiting queue, and capacity."""
+        request id + progress), the waiting queues, and capacity."""
         return {
             "num_slots": self.num_slots,
             "max_queue": self.max_queue,
+            "num_priorities": self.num_priorities,
+            "aging_every": self.aging_every,
             "free_slots": sorted(self._free),
             "queued_ids": self.queued_ids,
+            "queued_by_class": [len(q) for q in self._queues],
             "active": {
                 str(slot): {
                     "request_id": req.id,
                     "prompt_len": req.prompt_len,
+                    "priority": req.priority,
                     "generated": len(req.output_tokens),
                     "max_new_tokens": req.max_new_tokens,
+                    "dispatch_failures": req.dispatch_failures,
                 } for slot, req in sorted(self._active.items())},
         }
 
@@ -180,8 +357,8 @@ class SlotScheduler:
 
     @property
     def num_queued(self):
-        return len(self._queue)
+        return sum(len(q) for q in self._queues)
 
     @property
     def has_work(self):
-        return bool(self._queue or self._active)
+        return bool(self._active or any(self._queues))

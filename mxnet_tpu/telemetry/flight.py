@@ -51,8 +51,9 @@ import weakref
 from collections import deque
 
 __all__ = ["FlightRecorder", "install", "uninstall", "get", "record",
-           "trigger", "note_queue_full", "trainer_sentinel_enabled",
-           "latched_reasons", "watch", "unwatch"]
+           "trigger", "note_queue_full", "note_shed",
+           "trainer_sentinel_enabled", "latched_reasons", "watch",
+           "unwatch"]
 
 _recorder = None
 _lock = threading.Lock()
@@ -178,23 +179,35 @@ class FlightRecorder:
                          "after its owner declared steady state — "
                          "unexpected shape churn"})
 
-    # -- queue-full storm --------------------------------------------------
-    def note_queue_full(self, name="engine"):
-        """Timestamp one QueueFullError; trips `queue_full:<name>` when
-        the trailing window fills past the threshold."""
-        name = str(name)
+    # -- queue-full / shed storms ------------------------------------------
+    def _note_storm(self, kind, name):
+        """Shared rejection-storm detector: timestamp one event of
+        `kind` for component `name`; trips `<kind>:<name>` when the
+        trailing window fills past the threshold."""
         dq = self._queue_full.setdefault(
-            name, deque(maxlen=self.queue_full_threshold))
+            (kind, name), deque(maxlen=self.queue_full_threshold))
         now = time.monotonic()
         dq.append(now)
-        self.record("queue_full", component=name)
+        self.record(kind, component=name)
         if len(dq) == self.queue_full_threshold and \
                 now - dq[0] <= self.queue_full_window:
             self.trigger(
-                f"queue_full:{name}",
+                f"{kind}:{name}",
                 {"rejections": len(dq),
                  "window_s": round(now - dq[0], 4),
                  "threshold": self.queue_full_threshold})
+
+    def note_queue_full(self, name="engine"):
+        """Timestamp one QueueFullError; trips `queue_full:<name>` when
+        the trailing window fills past the threshold."""
+        self._note_storm("queue_full", str(name))
+
+    def note_shed(self, name="engine"):
+        """Timestamp one policy shed (the engine calls it on every
+        ShedError); trips `shed_storm:<name>` when the trailing window
+        fills past the queue-full threshold — sustained shedding is the
+        same anomaly class as a queue-full storm."""
+        self._note_storm("shed_storm", str(name))
 
     # -- trigger + dump ----------------------------------------------------
     def trigger(self, reason, detail=None):
@@ -313,6 +326,12 @@ def note_queue_full(name="engine"):
     rec = _recorder
     if rec is not None:
         rec.note_queue_full(name)
+
+
+def note_shed(name="engine"):
+    rec = _recorder
+    if rec is not None:
+        rec.note_shed(name)
 
 
 def latched_reasons():

@@ -8,7 +8,9 @@ introspection server"):
     /            tiny HTML index of the endpoints
     /healthz     200 "ok" — liveness; "degraded: <reasons>" (still
                  200, flagged body) while the flight recorder holds a
-                 latched dump
+                 latched dump OR a component flagged itself degraded
+                 via set_degraded() (the serving engine does under
+                 sustained overload)
     /metrics     Prometheus text exposition (0.0.4) of the registry
     /statusz     JSON: process info (uptime, RSS, python/jax versions),
                  registered component status (engine config/occupancy/
@@ -40,13 +42,37 @@ from urllib.parse import parse_qs, urlparse
 
 __all__ = ["serve", "stop_server", "get_server", "IntrospectionServer",
            "register_status_provider", "unregister_status_provider",
-           "collect_status"]
+           "collect_status", "set_degraded", "clear_degraded",
+           "degraded_reasons"]
 
 _T0 = time.time()
 _providers_lock = threading.Lock()
 _providers = {}            # name -> weakref-able callable () -> dict
 _server = None             # the default server started by serve()
 _server_lock = threading.Lock()
+_degraded_lock = threading.Lock()
+_degraded = {}             # component name -> reason
+
+
+def set_degraded(name, reason="overload"):
+    """Flag a component as gracefully degraded: /healthz answers
+    `degraded: <name>=<reason>` (still 200 — the process is alive and
+    serving, just not at full service) and /statusz grows a
+    `degraded` block. Cleared with clear_degraded(name)."""
+    with _degraded_lock:
+        _degraded[str(name)] = str(reason)
+
+
+def clear_degraded(name):
+    """Remove a component's degradation flag (no-op when absent)."""
+    with _degraded_lock:
+        _degraded.pop(str(name), None)
+
+
+def degraded_reasons():
+    """{component: reason} of currently degraded components."""
+    with _degraded_lock:
+        return dict(_degraded)
 
 
 def register_status_provider(name, fn):
@@ -134,6 +160,7 @@ def _statusz():
         "python": sys.version.split()[0],
         "jax_imported": "jax" in sys.modules,
         "flight_latched": flight.latched_reasons(),
+        "degraded": degraded_reasons(),
         "components": collect_status(),
         "jit_cache": {
             "retraces": _counter("jit_cache_retraces_total"),
@@ -193,9 +220,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(_INDEX, "text/html; charset=utf-8")
             elif url.path == "/healthz":
                 from . import flight
-                latched = flight.latched_reasons()
-                body = "ok\n" if not latched else \
-                    "degraded: " + ",".join(latched) + "\n"
+                reasons = list(flight.latched_reasons())
+                reasons.extend(f"{n}={r}" for n, r
+                               in sorted(degraded_reasons().items()))
+                body = "ok\n" if not reasons else \
+                    "degraded: " + ",".join(reasons) + "\n"
                 self._reply(body, "text/plain; charset=utf-8")
             elif url.path == "/metrics":
                 self._reply(render_prometheus(),

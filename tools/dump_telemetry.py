@@ -13,6 +13,7 @@ Usage:
     python tools/dump_telemetry.py --trace trace.json   # -> perfetto
     python tools/dump_telemetry.py --serve 9100 --linger 60
     python tools/dump_telemetry.py --cost     # MFU/roofline/compile
+    python tools/dump_telemetry.py --shed     # load-shedding headline
 
 --trace writes the run's request timelines + spans as Chrome
 trace_event JSON (open in ui.perfetto.dev). --serve starts the live
@@ -70,6 +71,45 @@ def run_serving():
     return eng, spec
 
 
+def run_shedding():
+    """A deliberately overloaded engine: tight watermarks, a one-shot
+    burst of mixed-priority deadline traffic — so the shed/overload/
+    degradation instruments carry real values in the dump."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import GPT2Config, GPT2ForCausalLM
+    from mxnet_tpu.serving import (RejectedError, Request, ServingEngine,
+                                   SheddingPolicy)
+
+    cfg = GPT2Config(vocab_size=97, units=32, num_layers=2, num_heads=2,
+                     max_length=64, dropout=0.0, attention_dropout=0.0)
+    net = GPT2ForCausalLM(cfg)
+    mx.rng.seed(0)
+    net.initialize(mx.init.Normal(0.05))
+    eng = ServingEngine(
+        net, num_slots=1, max_length=32, page_size=8, decode_block=2,
+        attn_impl="xla",
+        policy=SheddingPolicy(queue_low=1, queue_high=2,
+                              degrade_after=2, recover_after=2))
+    rng = np.random.default_rng(0)
+    reqs = [Request(rng.integers(0, cfg.vocab_size, 4).tolist(), 3,
+                    seed=i, priority=i % 3, request_id=400 + i,
+                    deadline_ms=None if i % 2 else 2000.0)
+            for i in range(10)]
+    shed = 0
+    for r in reqs:
+        try:
+            eng.submit(r)
+        except RejectedError:
+            shed += 1
+    while eng.has_work:
+        eng.step()
+    for _ in range(3):          # calm ticks so degradation recovers
+        eng.step()
+    return eng
+
+
 def run_training():
     import numpy as np
 
@@ -106,6 +146,10 @@ def main():
     ap.add_argument("--cost", action="store_true",
                     help="print the MFU/roofline/compile headline and "
                          "the HBM-ledger reconciliation")
+    ap.add_argument("--shed", action="store_true",
+                    help="also run an overloaded engine (tight "
+                         "watermarks, mixed-priority deadline burst) "
+                         "and print the load-shedding headline")
     ap.add_argument("--serve", type=int, default=None, metavar="PORT",
                     help="start the live introspection server (0 = any "
                          "free port)")
@@ -123,10 +167,12 @@ def main():
               "(/metrics /statusz /requests /trace /healthz)")
     if args.spans:
         telemetry.enable_jsonl(args.spans)
-    eng = spec = None
+    eng = spec = shed_eng = None
     with telemetry.span("dump_telemetry.workloads"):
         if args.workload in ("serving", "both"):
             eng, spec = run_serving()
+        if args.shed:
+            shed_eng = run_shedding()
         if args.workload in ("training", "both"):
             run_training()
     telemetry.memory.sample()
@@ -159,6 +205,18 @@ def main():
               f"({s['spec_accepted_tokens']}/{drafted}), "
               f"rollbacks {s['spec_rollbacks']}, "
               f"{per_disp:.2f} tokens/dispatch")
+    if shed_eng is not None:
+        # the load-shedding headline: what /statusz "robustness" and
+        # serving_shed_total{reason,priority} would show for the burst
+        rb = shed_eng._statusz()["robustness"]
+        s = shed_eng.stats
+        by = ", ".join(f"{k}:{v}" for k, v in sorted(rb["shed"].items()))
+        print(f"# shed: {s['shed']} total ({by or 'none'}), "
+              f"rejected {s['requests_rejected']}, "
+              f"finished {s['requests_finished']}, "
+              f"overload level {rb['overload_level']}, "
+              f"degraded {'yes' if rb['degraded'] else 'no'}, "
+              f"downgrades {rb['policy']['downgrades']}")
     if args.cost:
         # the /compilez + /memz headline, human-shaped: where every
         # dispatched program sits on the roofline and where HBM went
