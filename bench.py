@@ -1116,6 +1116,227 @@ def bench_gpt2_serving_overload():
         and overhead < 0.02 else 1
 
 
+def bench_gpt2_serving_router():
+    """Fault-tolerant multi-replica serving: the SAME Poisson request
+    stream served by 1 replica (fault-free reference) and by a
+    2-replica ServingRouter that loses replica 0 to a seeded mid-run
+    kill. The router exports the corpse's queued/in-flight requests
+    and migrates them to the survivor, continuing each one
+    bit-identically via the restart continuation — so the pass
+    criteria are ZERO lost requests and ZERO output mismatches for
+    every request both runs finished, with goodput (in-deadline
+    finishes per second of makespan), TTFT p99, and the migrated count
+    reported. vs_baseline is goodput_2rep_kill / goodput_1rep: the
+    fleet's headroom means losing half its capacity mid-run should
+    still roughly match the single replica the stream was sized
+    for."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.models import GPT2ForCausalLM, gpt2_774m_config
+    from mxnet_tpu.serving import (RejectedError, ReplicaFaultPlan,
+                                   Request, ServingEngine, ServingRouter)
+
+    # the bit-identity gate needs a counter-stable PRNG: under rbg
+    # (main() sets it for TPU dropout throughput) XLA's RngBitGenerator
+    # may emit different bits for the same per-request stream when the
+    # decode batch composition differs, and the 1- vs 2-replica runs
+    # necessarily batch differently. threefry is stable per
+    # (seed, token_index) regardless of batching.
+    prng_before = jax.config.jax_default_prng_impl
+    jax.config.update("jax_default_prng_impl", "threefry2x32")
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8))
+    block = int(os.environ.get("BENCH_SERVE_BLOCK", 8))
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS",
+                                    64 if on_tpu else 48))
+    kill_step = int(os.environ.get("BENCH_ROUTER_KILL_STEP", 12))
+    cfg = gpt2_774m_config(dtype="bfloat16" if on_tpu else "float32",
+                           dropout=0.0, attention_dropout=0.0)
+    max_len, page = 1024, 64
+    p_lo, p_hi, o_lo, o_hi = 16, 128, 32, 128
+    if not on_tpu:  # CPU smoke config
+        cfg.vocab_size, cfg.units, cfg.hidden_size = 512, 64, 256
+        cfg.num_layers, cfg.num_heads, cfg.max_length = 2, 2, 64
+        max_len, page = 64, 8
+        p_lo, p_hi, o_lo, o_hi = 2, 12, 4, 12
+        slots, block = min(slots, 4), min(block, 4)
+
+    net = GPT2ForCausalLM(cfg)
+    net.initialize(mx.init.Normal(0.02))
+    if on_tpu:
+        net.cast("bfloat16")
+
+    def mk_requests(n, id0, deadline_ms=None):
+        # reseeded per call -> every run sees the identical stream;
+        # every 3rd request extends one shared page-aligned prefix so
+        # affinity routing has something to exploit
+        rng = np.random.default_rng(41)
+        shared = rng.integers(0, cfg.vocab_size, page).tolist()
+        out = []
+        for i in range(n):
+            if i % 3 == 0 and p_hi > page:
+                prompt = shared + rng.integers(
+                    0, cfg.vocab_size,
+                    int(rng.integers(1, p_hi - page + 1))).tolist()
+            else:
+                prompt = rng.integers(
+                    0, cfg.vocab_size,
+                    int(rng.integers(p_lo, p_hi + 1))).tolist()
+            out.append(Request(prompt, int(rng.integers(o_lo, o_hi + 1)),
+                               do_sample=True, temperature=0.8, top_k=40,
+                               seed=i, request_id=id0 + i,
+                               deadline_ms=deadline_ms))
+        return out
+
+    def new_engine():
+        eng = ServingEngine(net, num_slots=slots, max_length=max_len,
+                            page_size=page, decode_block=block)
+        # warm prefill buckets up to p_hi + o_hi: a migrated request
+        # re-prefills prompt+emitted, which lands in buckets a
+        # prompt-only warmup never compiles — and a mid-run compile
+        # would dominate the CPU-smoke makespan
+        warm = [Request(list(range(1, b + 1)), 2, request_id=f"w{b}")
+                for b in range(page, min(p_hi + o_hi + page, max_len),
+                               page)]
+        eng.serve(warm)
+        eng.serve([Request(list(range(1, page + 1)), 2, do_sample=True,
+                           seed=0, request_id="w-s")])
+        eng.reset_stats()
+        return eng
+
+    def merged_ttft_p99_ms(engines):
+        fam = telemetry.get("serving_ttft_seconds")
+        kids = [fam.labels(e._eid) for e in engines]
+        kids = [k for k in kids if k.count]
+        if not kids:
+            return None
+        merged = telemetry.Histogram("ttft_merge",
+                                     buckets=kids[0].buckets)
+        for k in kids:
+            merged._counts = [a + b for a, b in
+                              zip(merged._counts, k._counts)]
+            merged._count += k.count
+            merged._sum += k.sum
+            merged._min = min(merged._min, k._min)
+            merged._max = max(merged._max, k._max)
+        return round(merged.percentile(99) * 1e3, 2)
+
+    # phase 1: closed-loop single-replica capacity + service time
+    eng = new_engine()
+    cap_reqs = mk_requests(n_requests, id0=1000)
+    t0 = time.perf_counter()
+    eng.serve(cap_reqs)
+    capacity_rps = n_requests / (time.perf_counter() - t0)
+    service_s = float(np.median([r.t_finish - r.t_admit
+                                 for r in cap_reqs]))
+    # generous deadline (vs the overload bench's tight one): the
+    # contrast here should come from the mid-run capacity loss, not
+    # from deadline carnage drowning the failover signal
+    deadline_ms = max(6e3 * service_s, 150.0)
+    rate = 1.5 * capacity_rps      # brisk for 1 replica, easy for 2
+
+    def run(n_replicas, id0, kill=False):
+        engines = [new_engine() for _ in range(n_replicas)]
+        router = ServingRouter(engines)
+        plan = None
+        if kill:
+            plan = ReplicaFaultPlan(kill={kill_step: 0}).install(router)
+        reqs = mk_requests(n_requests, id0=id0, deadline_ms=deadline_ms)
+        arr = np.cumsum(np.random.default_rng(43).exponential(
+            1.0 / rate, n_requests))
+        rejected = 0
+        t0 = time.perf_counter()
+        pending = list(zip(arr, reqs))
+        try:
+            while pending or router.has_work:
+                now = time.perf_counter() - t0
+                while pending and pending[0][0] <= now:
+                    try:
+                        router.submit(pending.pop(0)[1])
+                    except RejectedError:
+                        rejected += 1
+                if router.has_work:
+                    router.step()
+                elif pending:
+                    time.sleep(min(pending[0][0] - now, 0.01))
+        finally:
+            if plan is not None:
+                plan.uninstall()
+        dt = time.perf_counter() - t0
+        good = [r for r in reqs if r.status == "finished"
+                and (r.t_finish - r.t_submit) * 1e3 <= deadline_ms]
+        lost = [r for r in reqs
+                if r.status not in ("finished", "shed", "deadline")]
+        audits = [len(e.audit_pages()) for e in engines]
+        s = router.stats
+        return reqs, {
+            "goodput_req_per_sec": round(len(good) / dt, 3),
+            "finished_in_deadline": len(good),
+            "finished_total": sum(r.status == "finished" for r in reqs),
+            "rejected_at_submit": rejected,
+            "deadline_cancelled": sum(r.status == "deadline"
+                                      for r in reqs),
+            "lost": len(lost),
+            "migrated": s["migrated"],
+            "routed_affinity": s["affinity"],
+            "routed_spill": s["spill"],
+            "replica_down": s["replica_down"],
+            "ttft_p99_ms": merged_ttft_p99_ms(engines),
+            "audit_leaks": sum(audits),
+            "makespan_s": round(dt, 3),
+        }
+
+    try:
+        ref_reqs, ref = run(1, id0=2000)
+        kill_reqs, faulted = run(2, id0=3000, kill=True)
+    finally:
+        jax.config.update("jax_default_prng_impl", prng_before)
+
+    # bit-identity across the kill: every request BOTH runs finished
+    # must have byte-equal outputs (deadline/shed outcomes may differ —
+    # capacities differ — but no finished output may diverge)
+    ref_out = {r.id - 2000: list(r.output_tokens) for r in ref_reqs
+               if r.status == "finished"}
+    kill_out = {r.id - 3000: list(r.output_tokens) for r in kill_reqs
+                if r.status == "finished"}
+    both = set(ref_out) & set(kill_out)
+    mismatches = sum(ref_out[i] != kill_out[i] for i in both)
+
+    ratio = faulted["goodput_req_per_sec"] \
+        / max(ref["goodput_req_per_sec"], 1e-9)
+    _emit("gpt2_serving_router_goodput_req_per_sec",
+          faulted["goodput_req_per_sec"], "req/sec", round(ratio, 4),
+          extras={
+              "two_replicas_with_kill": faulted,
+              "one_replica_reference": ref,
+              "goodput_ratio": round(ratio, 3),
+              "output_mismatches": mismatches,
+              "compared_outputs": len(both),
+              "migrated": faulted["migrated"],
+              "capacity_1rep_req_per_sec": round(capacity_rps, 3),
+              "offered_req_per_sec": round(rate, 3),
+              "deadline_ms": round(deadline_ms, 1),
+              "kill_step": kill_step,
+              "requests": n_requests, "slots": slots,
+              "decode_block": block,
+              "prompt_lens": f"U[{p_lo},{p_hi}] (1/3 shared prefix)",
+              "output_lens": f"U[{o_lo},{o_hi}]",
+              "arrivals": f"poisson({round(rate, 2)}/s)",
+              "params": cfg.num_params(),
+              "device": str(dev.device_kind),
+              "baseline": "1-replica fault-free run above (reference "
+                          "has no serving path)",
+          })
+    ok = (mismatches == 0 and faulted["lost"] == 0
+          and faulted["audit_leaks"] == 0
+          and faulted["replica_down"].get("kill") == 1
+          and faulted["migrated"] >= 1)
+    return 0 if ok else 1
+
+
 def bench_longcontext():
     """Long-context attention: fwd+bwd through the blockwise flash path
     at sequence lengths whose (T, T) score matrix would not fit
@@ -1267,6 +1488,9 @@ def main():
     if workload in ("serving_overload", "overload", "shedding",
                     "gpt2_serving_overload"):
         return bench_gpt2_serving_overload()
+    if workload in ("serving_router", "router", "failover",
+                    "gpt2_serving_router"):
+        return bench_gpt2_serving_router()
     if workload == "decode":
         return bench_decode()
     if workload in ("longcontext", "long"):

@@ -31,6 +31,15 @@ before it queues, step() supervises dispatch faults (audit, rollback,
 retry, quarantine) instead of propagating them, and a seeded FaultPlan
 (serving/faults.py) drives all of it deterministically in tests.
 
+Above the single engine, `ServingRouter` (serving/router.py) fronts N
+replicas: radix-prefix-affinity placement with load-aware spill,
+health-driven failover (a killed or wedged replica's queued and
+in-flight requests migrate to survivors bit-identically via the
+restart continuation), p99-hedged dispatch with loser cancellation,
+and drain()/rejoin() rolling restarts — with `ReplicaFaultPlan`
+injecting replica-level kill/hang/degrade for fleet-wide chaos
+(docs/SERVING.md "Multi-replica serving & failover").
+
 See docs/SERVING.md for the architecture and slot lifecycle.
 """
 from .sampling import filtered_logits, sample_tokens, slot_keys  # noqa: F401
@@ -40,12 +49,14 @@ from .page_pool import PagePool, PagePoolExhausted  # noqa: F401
 from .prefix_cache import PrefixCache  # noqa: F401
 from .speculative import PromptLookupProposer, verify_tokens  # noqa: F401
 from .policy import SheddingPolicy  # noqa: F401
-from .faults import FaultError, FaultPlan  # noqa: F401
+from .faults import FaultError, FaultPlan, ReplicaFaultPlan  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
+from .router import ServingRouter  # noqa: F401
 
 __all__ = ["Request", "SlotScheduler", "RejectedError", "QueueFullError",
-           "ShedError", "ServingEngine", "SheddingPolicy",
-           "PagePool", "PagePoolExhausted", "PrefixCache",
-           "PromptLookupProposer", "FaultPlan", "FaultError",
+           "ShedError", "ServingEngine", "ServingRouter",
+           "SheddingPolicy", "PagePool", "PagePoolExhausted",
+           "PrefixCache", "PromptLookupProposer", "FaultPlan",
+           "FaultError", "ReplicaFaultPlan",
            "filtered_logits", "sample_tokens", "slot_keys",
            "verify_tokens"]

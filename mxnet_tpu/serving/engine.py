@@ -88,7 +88,7 @@ from .page_pool import PagePool, PagePoolExhausted
 from .prefix_cache import PrefixCache
 from .sampling import sample_tokens, slot_keys
 from .scheduler import (QueueFullError, Request, ShedError,
-                        SlotScheduler)
+                        SlotScheduler, _seq_counter)
 from .speculative import PromptLookupProposer, verify_tokens
 
 __all__ = ["ServingEngine"]
@@ -321,6 +321,7 @@ class ServingEngine:
         self.retry_backoff_s = float(retry_backoff_s)
         self._clock = clock if clock is not None else time.perf_counter
         self._degraded = False
+        self._draining = False
         self._finish_times = deque(maxlen=64)   # drain-rate window
         # extra lease rows audit_pages() should account for (the
         # fault-injection harness registers pages it holds here)
@@ -425,6 +426,13 @@ class ServingEngine:
         telemetry.register_status_provider(
             f"engine/{self._eid}", self._statusz)
         telemetry.flight.watch(f"engine{self._eid}", self._flight_probe)
+        # /readyz: readiness (warmed AND not degraded AND not draining)
+        # is per-component state, distinct from /healthz liveness — an
+        # intentionally-draining replica is healthy but not ready
+        _tserver.register_ready_probe(f"engine{self._eid}",
+                                      self._ready_probe)
+        weakref.finalize(self, _tserver.unregister_ready_probe,
+                         f"engine{self._eid}")
         # HBM ledger: weights + KV page slab + device-resident slot
         # state, with the prefix-cache-held page subset as an
         # informational detail (it lives inside kv_pages)
@@ -465,6 +473,7 @@ class ServingEngine:
             "requests_failed": int(m["requests_failed"].value),
             "overload_level": int(m["overload_level"].value),
             "degraded": int(m["degraded"].value),
+            "draining": self._draining,
             "shed": sum(self._shed_counts.values()),
         }
 
@@ -551,6 +560,8 @@ class ServingEngine:
             "admission_capacity": self.admission_capacity_estimate(),
             "robustness": {
                 "degraded": self._degraded,
+                "draining": self._draining,
+                "warmed": self._steady,
                 "overload_level": int(s["overload_level"]),
                 "policy": None if self.policy is None
                 else self.policy.snapshot(),
@@ -667,13 +678,25 @@ class ServingEngine:
             return None
         return self.scheduler.num_queued / rate
 
+    def estimated_drain_wait(self):
+        """Seconds until EVERYTHING in flight (queued + active) would
+        complete at the recent finish rate — the retry-after estimate a
+        draining replica attaches to its rejections (retrying sooner
+        than the drain completes cannot succeed)."""
+        rate = self._drain_rate()
+        if rate is None:
+            return None
+        return (self.scheduler.num_queued
+                + self.scheduler.num_active) / rate
+
     def _reject(self, request, reason, cause=None):
         """Common rejection tail: count, record the terminal timeline
         with structured context, and raise (the scheduler's
         QueueFullError enriched in place, or a fresh ShedError)."""
         depth = self.scheduler.num_queued
         active = self.scheduler.num_active
-        wait = self.estimated_queue_wait()
+        wait = self.estimated_drain_wait() if self._draining \
+            else self.estimated_queue_wait()
         if wait is not None:
             self._metrics["retry_after"].set(wait)
         request.status = "shed"
@@ -700,6 +723,53 @@ class ServingEngine:
             reason=reason, queue_depth=depth, active_slots=active,
             retry_after_s=wait, priority=request.priority)
 
+    # -- drain / readiness (serving/router.py consumes these) --------------
+    @property
+    def draining(self):
+        return self._draining
+
+    @property
+    def drained(self):
+        """True once a drain() completed: admission closed AND no
+        queued or running work remains (slots and pages all released —
+        audit_pages() is clean here by construction)."""
+        return self._draining and not self.scheduler.has_work
+
+    @property
+    def warmed(self):
+        """True after mark_warm(): every program is compiled."""
+        return self._steady
+
+    def is_ready(self):
+        """Readiness for new traffic: warmed AND not degraded AND not
+        draining — the /readyz conjunction. Liveness is separate: a
+        not-ready engine still serves its in-flight work."""
+        return self._steady and not self._degraded \
+            and not self._draining
+
+    def _ready_probe(self):
+        return {"warmed": self._steady, "degraded": self._degraded,
+                "draining": self._draining}
+
+    def drain(self):
+        """Begin a rolling-restart drain: new submit() rejects with
+        ShedError(reason="draining", retry_after_s=<drain estimate>),
+        while queued and running requests keep being served by step()
+        until the engine is empty (`drained` flips True, page audit
+        clean). Rejoin the fleet with undrain(); readiness also needs
+        mark_warm() (a restarted replica recompiles). Idempotent."""
+        if self._draining:
+            return
+        self._draining = True
+        telemetry.flight.record("draining", engine=self._eid)
+
+    def undrain(self):
+        """Reopen admission after a drain (no-op when not draining)."""
+        if not self._draining:
+            return
+        self._draining = False
+        telemetry.flight.record("undrained", engine=self._eid)
+
     # -- public API --------------------------------------------------------
     def submit(self, request):
         """Queue a Request (validated against this engine's capacity).
@@ -718,6 +788,8 @@ class ServingEngine:
             raise MXNetError(
                 f"prompt of {request.prompt_len} tokens exceeds slot "
                 f"capacity {self.max_length}")
+        if self._draining:
+            self._reject(request, "draining")
         now = self._clock()
         request.t_submit = now
         request.t_deadline = None if request.deadline_ms is None \
@@ -765,6 +837,78 @@ class ServingEngine:
         self._set_load_gauges()
         self._set_pool_gauges()
         return req
+
+    # -- migration seams (serving/router.py failover + drain) --------------
+    def adopt(self, request, migrated_from=None):
+        """Queue a request EXPORTED from another replica, preserving
+        its emitted tokens: admission re-prefills prompt+emitted and
+        resumes the RNG counter at the next token index (the same
+        restart continuation a rolled-back request uses), so a migrated
+        output is bit-identical to an unfaulted run on the original
+        replica. Unlike submit(), class queue bounds do not apply —
+        the fleet already accepted this request — and t_submit /
+        t_deadline carry over (router and replicas share one clock
+        domain). Raises while draining; rejects oversized sequences."""
+        if self._draining:
+            self._reject(request, "draining")
+        total = request.prompt_len + len(request.output_tokens)
+        if total > self.max_length:
+            self._metrics["requests_rejected"].inc()
+            raise MXNetError(
+                f"sequence of {total} tokens (prompt + emitted) exceeds "
+                f"slot capacity {self.max_length}")
+        now = self._clock()
+        if request.t_submit is None:
+            request.t_submit = now
+        request.priority = min(max(int(request.priority), 0),
+                               self.scheduler.num_priorities - 1)
+        if request._seq is None:
+            request._seq = next(_seq_counter)
+        request.dispatch_failures = 0
+        request.t_not_before = 0.0
+        self.scheduler.requeue(request)
+        request.status = "queued"
+        telemetry.request_log.begin(
+            request.id, self._eid, prompt_len=request.prompt_len,
+            max_new_tokens=request.max_new_tokens,
+            priority=request.priority,
+            deadline_ms=request.deadline_ms,
+            migrated_from=migrated_from,
+            resumed_tokens=len(request.output_tokens))
+        self._metrics["queue_depth"].set(self.scheduler.num_queued)
+        return request
+
+    def export_requests(self):
+        """Remove and return EVERY queued and in-flight request
+        (original submit order), releasing slots and page leases. The
+        emitted tokens stay on each Request, so a survivor replica can
+        adopt() them and continue bit-identically. Device syncs are
+        best-effort — the caller may be abandoning a wedged replica,
+        whose device state no longer matters; host-side lease
+        accounting is always rolled back."""
+        out = list(self.scheduler.queued_requests())
+        for q in self.scheduler._queues:
+            q.clear()
+        for slot in list(self.scheduler.active_slots):
+            req = self.scheduler.request_at(slot)
+            try:
+                self._release_slot(slot)
+            except Exception:       # noqa: BLE001 — wedged replica
+                try:
+                    self.scheduler.release(slot)
+                except Exception:   # noqa: BLE001
+                    pass
+                self._free_slot_pages(slot)
+            out.append(req)
+        out.sort(key=lambda r: r._seq if r._seq is not None else -1)
+        for req in out:
+            req.status = "exported"
+            telemetry.request_log.end(
+                req.id, self._eid, "migrated",
+                tokens=len(req.output_tokens))
+        self._set_load_gauges()
+        self._set_pool_gauges()
+        return out
 
     @property
     def has_work(self):

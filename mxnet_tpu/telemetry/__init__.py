@@ -64,7 +64,8 @@ from .request_trace import (  # noqa: F401
 from .server import (  # noqa: F401
     IntrospectionServer, serve, stop_server, get_server,
     register_status_provider, unregister_status_provider,
-    collect_status,
+    collect_status, register_ready_probe, unregister_ready_probe,
+    readiness, component_ready,
 )
 from . import cost  # noqa: F401
 from . import flight  # noqa: F401
@@ -81,6 +82,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "Registry",
            "chrome_trace", "IntrospectionServer", "serve",
            "stop_server", "get_server", "register_status_provider",
            "unregister_status_provider", "collect_status",
+           "register_ready_probe", "unregister_ready_probe",
+           "readiness", "component_ready",
            "cost", "flight", "ledger", "memory"]
 
 #: The process-global registry every framework instrument lives in.

@@ -11,6 +11,17 @@ introspection server"):
                  latched dump OR a component flagged itself degraded
                  via set_degraded() (the serving engine does under
                  sustained overload)
+    /readyz      readiness, distinct from liveness: components
+                 register a probe (register_ready_probe) reporting
+                 {warmed, degraded, draining}; a component is ready
+                 when warmed AND not degraded AND not draining. 200
+                 while at least one registered component is ready
+                 (or none registered), 503 otherwise — so ONE
+                 intentionally-draining replica never flips the whole
+                 process not-ready. ?component=<name> scopes the
+                 answer to one component (503 when it is not ready or
+                 unknown). External LBs and the ServingRouter consume
+                 this; /healthz stays pure liveness.
     /metrics     Prometheus text exposition (0.0.4) of the registry
     /statusz     JSON: process info (uptime, RSS, python/jax versions),
                  registered component status (engine config/occupancy/
@@ -43,7 +54,8 @@ from urllib.parse import parse_qs, urlparse
 __all__ = ["serve", "stop_server", "get_server", "IntrospectionServer",
            "register_status_provider", "unregister_status_provider",
            "collect_status", "set_degraded", "clear_degraded",
-           "degraded_reasons"]
+           "degraded_reasons", "register_ready_probe",
+           "unregister_ready_probe", "readiness", "component_ready"]
 
 _T0 = time.time()
 _providers_lock = threading.Lock()
@@ -52,6 +64,8 @@ _server = None             # the default server started by serve()
 _server_lock = threading.Lock()
 _degraded_lock = threading.Lock()
 _degraded = {}             # component name -> reason
+_ready_lock = threading.Lock()
+_ready_probes = {}         # name -> weakref-able callable () -> dict
 
 
 def set_degraded(name, reason="overload"):
@@ -73,6 +87,67 @@ def degraded_reasons():
     """{component: reason} of currently degraded components."""
     with _degraded_lock:
         return dict(_degraded)
+
+
+def _weakly(fn):
+    """Hold `fn` via WeakMethod when it is a bound method, so a dead
+    owner drops its registration instead of leaking it."""
+    if hasattr(fn, "__self__"):
+        ref = weakref.WeakMethod(fn)
+        return lambda: ref()
+    return lambda: fn
+
+
+def register_ready_probe(name, fn):
+    """Publish a readiness probe for one component under `name`:
+    `fn() -> {"warmed": bool, "degraded": bool-or-reason,
+    "draining": bool}`. The component is READY when warmed and not
+    degraded and not draining — /readyz serves the per-component
+    conjunctions. Bound methods are held weakly (see
+    register_status_provider)."""
+    with _ready_lock:
+        _ready_probes[str(name)] = _weakly(fn)
+
+
+def unregister_ready_probe(name):
+    with _ready_lock:
+        _ready_probes.pop(str(name), None)
+
+
+def readiness():
+    """{component: {"warmed", "degraded", "draining", "ready"}} for
+    every registered probe. Dead weakrefs drop out; a probe that
+    raises reports ready=False with the error (a broken component is
+    not ready, but must not break the endpoint)."""
+    with _ready_lock:
+        items = list(_ready_probes.items())
+    out = {}
+    dead = []
+    for name, get in items:
+        fn = get()
+        if fn is None:
+            dead.append(name)
+            continue
+        try:
+            st = dict(fn())
+            st["ready"] = bool(st.get("warmed")
+                               and not st.get("degraded")
+                               and not st.get("draining"))
+        except Exception as e:
+            st = {"ready": False,
+                  "error": f"{type(e).__name__}: {e}"}
+        out[name] = st
+    if dead:
+        with _ready_lock:
+            for name in dead:
+                _ready_probes.pop(name, None)
+    return out
+
+
+def component_ready(name):
+    """One component's readiness (None when no such probe)."""
+    st = readiness().get(str(name))
+    return None if st is None else st["ready"]
 
 
 def register_status_provider(name, fn):
@@ -161,6 +236,7 @@ def _statusz():
         "jax_imported": "jax" in sys.modules,
         "flight_latched": flight.latched_reasons(),
         "degraded": degraded_reasons(),
+        "readiness": readiness(),
         "components": collect_status(),
         "jit_cache": {
             "retraces": _counter("jit_cache_retraces_total"),
@@ -191,6 +267,8 @@ _INDEX = """<!doctype html><title>mx.telemetry</title>
 <li><a href="/memz">/memz</a> — HBM ledger vs live-array bytes</li>
 <li><a href="/healthz">/healthz</a> — liveness (degraded while a
  flight dump is latched)</li>
+<li><a href="/readyz">/readyz</a> — readiness (warmed &and; not
+ degraded &and; not draining, per component; ?component=name)</li>
 </ul>"""
 
 
@@ -226,6 +304,20 @@ class _Handler(BaseHTTPRequestHandler):
                 body = "ok\n" if not reasons else \
                     "degraded: " + ",".join(reasons) + "\n"
                 self._reply(body, "text/plain; charset=utf-8")
+            elif url.path == "/readyz":
+                comps = readiness()
+                which = q.get("component", [None])[0]
+                if which is not None:
+                    st = comps.get(which)
+                    ready = bool(st and st["ready"])
+                    body = {"component": which, "ready": ready,
+                            "state": st}
+                else:
+                    ready = (not comps) or any(
+                        c["ready"] for c in comps.values())
+                    body = {"ready": ready, "components": comps}
+                self._reply(json.dumps(body, sort_keys=True),
+                            code=200 if ready else 503)
             elif url.path == "/metrics":
                 self._reply(render_prometheus(),
                             "text/plain; version=0.0.4; charset=utf-8")

@@ -41,7 +41,7 @@ import sys
 __all__ = ["load_records", "compare", "main"]
 
 _LOWER_BETTER = ("latency", "_ms", "seconds", "bytes", "loss",
-                 "overhead", "ttft")
+                 "overhead", "ttft", "mismatch")
 
 
 def lower_is_better(name):

@@ -38,6 +38,8 @@ def register_everything():
     # lazily-declared families, forced explicitly:
     from mxnet_tpu.serving import engine as serving_engine
     serving_engine._engine_metrics("catalog-check")
+    from mxnet_tpu.serving import router as serving_router
+    serving_router._router_metrics("catalog-check")
     telemetry.memory._gauges(telemetry.default_registry)
     telemetry.cost._metrics()                  # cost/compile family
     telemetry.ledger._gauges(telemetry.default_registry)

@@ -14,6 +14,7 @@ Usage:
     python tools/dump_telemetry.py --serve 9100 --linger 60
     python tools/dump_telemetry.py --cost     # MFU/roofline/compile
     python tools/dump_telemetry.py --shed     # load-shedding headline
+    python tools/dump_telemetry.py --router   # multi-replica headline
 
 --trace writes the run's request timelines + spans as Chrome
 trace_event JSON (open in ui.perfetto.dev). --serve starts the live
@@ -110,6 +111,45 @@ def run_shedding():
     return eng
 
 
+def run_router():
+    """A two-replica router with aggressive hedging and a seeded
+    mid-run replica kill — so the router_* instruments (placement,
+    migration, hedging, replica-down) carry real values in the dump."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import GPT2Config, GPT2ForCausalLM
+    from mxnet_tpu.serving import (ReplicaFaultPlan, Request,
+                                   ServingEngine, ServingRouter)
+
+    cfg = GPT2Config(vocab_size=97, units=32, num_layers=2, num_heads=2,
+                     max_length=64, dropout=0.0, attention_dropout=0.0)
+    net = GPT2ForCausalLM(cfg)
+    mx.rng.seed(0)
+    net.initialize(mx.init.Normal(0.05))
+    engines = [ServingEngine(net, num_slots=2, max_length=32, page_size=8,
+                             decode_block=2, attn_impl="xla")
+               for _ in range(2)]
+    router = ServingRouter(engines, hedge_after_s=0.0)
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, cfg.vocab_size, 8).tolist()
+    reqs = [Request(shared + rng.integers(1, cfg.vocab_size, 3).tolist()
+                    if i % 2 else
+                    rng.integers(1, cfg.vocab_size, 6).tolist(), 5,
+                    seed=i, request_id=500 + i) for i in range(10)]
+    plan = ReplicaFaultPlan(kill={6: 0}).install(router)
+    try:
+        for r in reqs:
+            router.submit(r)
+        steps = 0
+        while router.has_work and steps < 5000:
+            router.step()
+            steps += 1
+    finally:
+        plan.uninstall()
+    return router
+
+
 def run_training():
     import numpy as np
 
@@ -150,6 +190,10 @@ def main():
                     help="also run an overloaded engine (tight "
                          "watermarks, mixed-priority deadline burst) "
                          "and print the load-shedding headline")
+    ap.add_argument("--router", action="store_true",
+                    help="also run a two-replica router with hedging "
+                         "and a seeded mid-run replica kill and print "
+                         "the multi-replica headline")
     ap.add_argument("--serve", type=int, default=None, metavar="PORT",
                     help="start the live introspection server (0 = any "
                          "free port)")
@@ -167,12 +211,14 @@ def main():
               "(/metrics /statusz /requests /trace /healthz)")
     if args.spans:
         telemetry.enable_jsonl(args.spans)
-    eng = spec = shed_eng = None
+    eng = spec = shed_eng = router = None
     with telemetry.span("dump_telemetry.workloads"):
         if args.workload in ("serving", "both"):
             eng, spec = run_serving()
         if args.shed:
             shed_eng = run_shedding()
+        if args.router:
+            router = run_router()
         if args.workload in ("training", "both"):
             run_training()
     telemetry.memory.sample()
@@ -217,6 +263,24 @@ def main():
               f"overload level {rb['overload_level']}, "
               f"degraded {'yes' if rb['degraded'] else 'no'}, "
               f"downgrades {rb['policy']['downgrades']}")
+    if router is not None:
+        # the multi-replica headline: placement quality, failover and
+        # hedging outcomes, and where each replica stands right now
+        s = router.stats
+        st = router._statusz()
+        occ = ", ".join(
+            f"engine{r['engine']}[{r['state']}"
+            + (f":{r['down_reason']}" if r["down_reason"] else "")
+            + f"] q{r['queued']}/a{r['active']}"
+            for r in st["replicas"])
+        downs = ", ".join(f"{k}:{v}" for k, v in
+                          sorted(s["replica_down"].items()))
+        print(f"# router: {s['requests']} routed "
+              f"(affinity {s['affinity']}, spill {s['spill']}), "
+              f"migrated {s['migrated']}, hedges {s['hedges']} "
+              f"(won {s['hedges_won']}, wasted {s['hedges_wasted']}), "
+              f"replica-down {{{downs or 'none'}}}, "
+              f"ready {s['replicas_ready']}/{s['replicas']} — {occ}")
     if args.cost:
         # the /compilez + /memz headline, human-shaped: where every
         # dispatched program sits on the roofline and where HBM went
