@@ -1337,6 +1337,193 @@ def bench_gpt2_serving_router():
     return 0 if ok else 1
 
 
+def bench_gpt2_serving_multitenant():
+    """Multi-tenant LoRA serving: ONE resident base model serves a
+    Poisson stream from 3 tenants — two equal-weight well-behaved
+    tenants and one hog submitting ~2x their rate under a TenantQuota
+    — across more registered adapters than the slab holds, so the
+    pool pages low-rank deltas in and out (LRU) while every dispatch
+    reuses the SAME compiled programs (per-slot slab indices are
+    runtime data). Reports aggregate tokens/sec, per-tenant TTFT p99,
+    the adapter page-in rate (slab churn per prefill), Jain's
+    fairness index over the equal tenants' token throughput, and
+    steady_state_compiles. Pass criteria: ZERO compiles after warmup
+    across adapter churn, clean page AND adapter audits, the hog
+    visibly quota-capped (sheds > 0, every quota-admitted request
+    still finishes), and fairness ≥ 0.8 between the equal tenants.
+    vs_baseline is the Jain index (1.0 = perfectly fair)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import GPT2ForCausalLM, gpt2_774m_config
+    from mxnet_tpu.serving import (AdapterPool, RejectedError, Request,
+                                   ServingEngine, TenantQuota,
+                                   random_lora)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8))
+    block = int(os.environ.get("BENCH_SERVE_BLOCK", 8))
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS",
+                                    64 if on_tpu else 48))
+    n_adapters = int(os.environ.get("BENCH_ADAPTERS", 6))
+    pool_slots = int(os.environ.get("BENCH_ADAPTER_SLOTS", 4))
+    rank = int(os.environ.get("BENCH_ADAPTER_RANK", 8 if on_tpu else 2))
+    cfg = gpt2_774m_config(dtype="bfloat16" if on_tpu else "float32",
+                           dropout=0.0, attention_dropout=0.0)
+    max_len, page = 1024, 64
+    p_lo, p_hi, o_lo, o_hi = 16, 128, 32, 128
+    if not on_tpu:  # CPU smoke config
+        cfg.vocab_size, cfg.units, cfg.hidden_size = 512, 64, 256
+        cfg.num_layers, cfg.num_heads, cfg.max_length = 2, 2, 64
+        max_len, page = 64, 8
+        p_lo, p_hi, o_lo, o_hi = 2, 12, 4, 12
+        slots, block = min(slots, 4), min(block, 4)
+
+    net = GPT2ForCausalLM(cfg)
+    net.initialize(mx.init.Normal(0.02))
+    if on_tpu:
+        net.cast("bfloat16")
+
+    pool = AdapterPool(cfg, slots=pool_slots, max_rank=rank)
+    adapters = [f"ft{i}" for i in range(n_adapters)]
+    for i, name in enumerate(adapters):
+        pool.register(name, random_lora(cfg, rank=rank, seed=60 + i,
+                                        scale=0.02))
+    # hog: bounded queue + half the decode slots; aria/bold: equal
+    # weight, no hard cap — fairness between THEM is the Jain gate
+    quotas = {"hog": TenantQuota(max_active=max(1, slots // 2),
+                                 max_queue=max(2, slots // 2)),
+              "aria": TenantQuota(weight=1.0),
+              "bold": TenantQuota(weight=1.0)}
+    eng = ServingEngine(net, num_slots=slots, max_length=max_len,
+                        page_size=page, decode_block=block,
+                        adapter_pool=pool, tenant_quotas=quotas)
+
+    def mk_requests(n, id0):
+        # reseeded per call -> identical stream every run; the hog
+        # owns every even index (2x each equal tenant's share), and
+        # adapters rotate so consecutive admissions churn the slab
+        rng = np.random.default_rng(47)
+        out = []
+        for i in range(n):
+            tenant = "hog" if i % 2 == 0 else \
+                ("aria" if i % 4 == 1 else "bold")
+            out.append(Request(
+                rng.integers(0, cfg.vocab_size,
+                             int(rng.integers(p_lo, p_hi + 1))).tolist(),
+                int(rng.integers(o_lo, o_hi + 1)),
+                do_sample=bool(i % 2), temperature=0.8, top_k=40,
+                seed=i, request_id=id0 + i, tenant=tenant,
+                adapter_id=adapters[i % n_adapters]))
+        return out
+
+    # warmup: every prefill bucket with an adapter worn, the
+    # greedy-only decode composition, then the sampled one (separate
+    # serves — the decode program specializes on the batch's sampling
+    # mix) — after this, adapter churn must be free
+    warm = [Request(list(range(1, b + 1)), 2, request_id=f"w{b}",
+                    adapter_id=adapters[b % n_adapters])
+            for b in range(page, min(p_hi + page, max_len), page)]
+    eng.serve(warm)
+    eng.serve([Request(list(range(1, page + 1)), 2, do_sample=True,
+                       seed=0, request_id="w-s",
+                       adapter_id=adapters[0])])
+    eng.reset_stats()
+    c0 = _engine_compiles(eng._eid)
+
+    # phase 1: closed-loop capacity (quota-free tenant mix never hits
+    # the hog cap here — serve() drains as fast as slots allow)
+    cap_reqs = mk_requests(n_requests, id0=1000)
+    t0 = time.perf_counter()
+    done = eng.serve(cap_reqs)
+    capacity_rps = len(done) / (time.perf_counter() - t0)
+    eng.reset_stats()
+
+    # phase 2: open-loop Poisson at ~1.5x capacity so queues form and
+    # the hog's quota actually binds
+    rate = 1.5 * capacity_rps
+    reqs = mk_requests(n_requests, id0=2000)
+    arr = np.cumsum(np.random.default_rng(49).exponential(
+        1.0 / rate, n_requests))
+    shed = {t: 0 for t in quotas}
+    t0 = time.perf_counter()
+    pending = list(zip(arr, reqs))
+    while pending or eng.has_work:
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            r = pending.pop(0)[1]
+            try:
+                eng.submit(r)
+            except RejectedError:
+                shed[r.tenant] += 1
+        if eng.has_work:
+            eng.step()
+        elif pending:
+            time.sleep(min(pending[0][0] - now, 0.01))
+    dt = time.perf_counter() - t0
+
+    fin = [r for r in reqs if r.status == "finished"]
+    tokens = sum(len(r.output_tokens) for r in fin)
+    by_tenant = {t: [r for r in fin if r.tenant == t] for t in quotas}
+
+    def ttft_p99_ms(rs):
+        w = [(r.token_times[0] - r.t_submit) * 1e3 for r in rs
+             if r.token_times]
+        return round(float(np.percentile(w, 99)), 2) if w else None
+
+    eq = [sum(len(r.output_tokens) for r in by_tenant[t])
+          for t in ("aria", "bold")]
+    jain = (sum(eq) ** 2 / (len(eq) * sum(x * x for x in eq))
+            if sum(eq) else 0.0)
+    steady_compiles = _engine_compiles(eng._eid) - c0
+    s = eng.stats
+    page_in_rate = pool.page_ins / max(s["prefills"], 1)
+    tstats = eng.tenant_stats()
+    lost = [r for r in reqs if r.status not in ("finished", "rejected")]
+
+    _emit("gpt2_serving_multitenant_tokens_per_sec",
+          round(tokens / dt, 2), "tokens/sec", round(jain, 4),
+          extras={
+              "fairness_jain_equal_tenants": round(jain, 4),
+              "steady_state_compiles": steady_compiles,
+              "adapter_page_ins": pool.page_ins,
+              "adapter_page_in_rate_per_prefill": round(page_in_rate, 3),
+              "adapter_evictions": pool.evictions,
+              "adapters_registered": n_adapters,
+              "adapter_slab_slots": pool_slots - 1,
+              "adapter_rank": rank,
+              "adapter_slab_bytes": pool.slab_bytes(),
+              "ttft_p99_ms": {t: ttft_p99_ms(by_tenant[t])
+                              for t in sorted(quotas)},
+              "finished": {t: len(by_tenant[t]) for t in sorted(quotas)},
+              "tokens": {t: sum(len(r.output_tokens)
+                                for r in by_tenant[t])
+                         for t in sorted(quotas)},
+              "shed_at_submit": shed,
+              "tenant_stats": tstats,
+              "audit_leaks": len(eng.audit_pages())
+              + len(eng.audit_adapters()),
+              "capacity_req_per_sec": round(capacity_rps, 3),
+              "offered_req_per_sec": round(rate, 3),
+              "requests": n_requests, "slots": slots,
+              "decode_block": block, "makespan_s": round(dt, 3),
+              "prompt_lens": f"U[{p_lo},{p_hi}]",
+              "output_lens": f"U[{o_lo},{o_hi}]",
+              "arrivals": f"poisson({round(rate, 2)}/s)",
+              "params": cfg.num_params(),
+              "device": str(dev.device_kind),
+              "baseline": "Jain fairness index between the equal-weight "
+                          "tenants (1.0 = perfectly fair)",
+          })
+    ok = (steady_compiles == 0
+          and not eng.audit_pages() and not eng.audit_adapters()
+          and not lost
+          and shed["hog"] > 0 and not shed["aria"] and not shed["bold"]
+          and pool.page_ins > pool_slots - 1   # churn actually happened
+          and jain >= 0.8)
+    return 0 if ok else 1
+
+
 def bench_longcontext():
     """Long-context attention: fwd+bwd through the blockwise flash path
     at sequence lengths whose (T, T) score matrix would not fit
@@ -1491,6 +1678,9 @@ def main():
     if workload in ("serving_router", "router", "failover",
                     "gpt2_serving_router"):
         return bench_gpt2_serving_router()
+    if workload in ("serving_multitenant", "multitenant", "lora",
+                    "gpt2_serving_multitenant"):
+        return bench_gpt2_serving_multitenant()
     if workload == "decode":
         return bench_decode()
     if workload in ("longcontext", "long"):

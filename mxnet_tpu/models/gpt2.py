@@ -28,7 +28,28 @@ from ..ops import nn as _opnn
 from .kv_cache import KVCache, PagedKVCache
 
 __all__ = ["GPT2Config", "GPT2Model", "GPT2ForCausalLM", "gpt2_small_config",
-           "gpt2_medium_config", "gpt2_774m_config", "gpt2_xl_config"]
+           "gpt2_medium_config", "gpt2_774m_config", "gpt2_xl_config",
+           "set_adapter_ctx"]
+
+# -- serving LoRA adapter context -------------------------------------------
+# The serving engine sets this (to TRACED slab arrays) around
+# model.forward while tracing its compiled programs, so the batched
+# forward gathers each row's low-rank delta without the model's public
+# signature growing adapter arguments. (A, B, scale, slots): the
+# AdapterPool slab — A (4, L, S, U, R), B (4, L, S, R, U), scale (S,)
+# — plus the per-batch-row slab slot ids (Bsz,) int32. Slot 0 is the
+# null adapter (zeros, scale 0), so rows without an adapter add an
+# exact zero. None everywhere outside those traces.
+_adapter_ctx = None
+
+
+def set_adapter_ctx(ctx):
+    """Install the serving adapter context; returns the previous value
+    so callers can restore it in a finally block."""
+    global _adapter_ctx
+    prev = _adapter_ctx
+    _adapter_ctx = ctx
+    return prev
 
 
 class GPT2Config:
@@ -107,27 +128,51 @@ class GPT2Attention(HybridBlock):
         x = x.reshape((b, t, h, d))
         return x if bthd else x.transpose((0, 2, 1, 3))
 
+    def _lora(self, y, pidx, layer_idx, x):
+        """y + this batch's low-rank delta for projection `pidx`
+        (0..3 = query/key/value/proj, the slab's leading axis):
+        ``x @ A_s @ B_s * alpha/r`` with each row s gathering its own
+        slab slot. No-op (returns y untouched — the compiled program
+        is byte-identical to the adapter-free one) outside a serving
+        adapter context."""
+        ctx = _adapter_ctx
+        if ctx is None or layer_idx is None:
+            return y
+        A, B, scale, slots = ctx
+        xd = x._data if isinstance(x, NDArray) else x
+        ag = jnp.take(A[pidx, layer_idx], slots, axis=0)   # (Bsz, U, R)
+        bg = jnp.take(B[pidx, layer_idx], slots, axis=0)   # (Bsz, R, U)
+        s = jnp.take(scale, slots, axis=0)                 # (Bsz,)
+        d = jnp.einsum("btu,bur->btr", xd.astype(A.dtype), ag)
+        d = jnp.einsum("btr,bru->btu", d, bg)
+        d = (d.astype(jnp.float32) * s[:, None, None]).astype(xd.dtype)
+        yd = y._data if isinstance(y, NDArray) else y
+        return NDArray(yd + d)
+
     def forward(self, x, cache=None, layer_idx=None):
         if cache is None:
             # training path: head split stays in BTHD — the attention op
             # consumes it natively (packed Pallas kernel), so no
             # (B,T,H,D)->(B,H,T,D) relayout copies hit HBM
-            q = self._split(self.query(x), bthd=True)
-            k = self._split(self.key(x), bthd=True)
-            v = self._split(self.value(x), bthd=True)
+            q = self._split(self._lora(self.query(x), 0, layer_idx, x),
+                            bthd=True)
+            k = self._split(self._lora(self.key(x), 1, layer_idx, x),
+                            bthd=True)
+            v = self._split(self._lora(self.value(x), 2, layer_idx, x),
+                            bthd=True)
             out = _opnn.dot_product_attention(
                 q, k, v, causal=True, dropout_p=self._dropout,
                 impl=self._impl, layout="BTHD")
             b, t, h, d = out.shape
             out = out.reshape((b, t, h * d))
-            return self.proj(out), cache
+            return self._lora(self.proj(out), 3, layer_idx, out), cache
         # static-cache path (inference): write this chunk at position
         # cache.length, attend over the full buffer under a validity ×
         # causal mask. The chunk is either the whole prompt (prefill)
         # or one token (decode). Cache blocks are laid out BHTD.
-        q = self._split(self.query(x))
-        k = self._split(self.key(x))
-        v = self._split(self.value(x))
+        q = self._split(self._lora(self.query(x), 0, layer_idx, x))
+        k = self._split(self._lora(self.key(x), 1, layer_idx, x))
+        v = self._split(self._lora(self.value(x), 2, layer_idx, x))
         t = q.shape[2]
         if getattr(cache, "ragged", False):
             # ragged serving decode: each slot appends at its OWN length
@@ -160,7 +205,8 @@ class GPT2Attention(HybridBlock):
                     impl=impl, interpret=interp)
                 b, tq, h, d = out.shape
                 out = out.astype(q._data.dtype).reshape(b, tq, h * d)
-            return self.proj(NDArray(out)), cache
+            out = NDArray(out)
+            return self._lora(self.proj(out), 3, layer_idx, out), cache
         if t > 1:
             k_all, v_all, cache = cache.write_prompt(
                 layer_idx, k._data, v._data)
@@ -178,7 +224,7 @@ class GPT2Attention(HybridBlock):
             impl="xla" if self._impl == "ring" else self._impl)
         b, h, t, d = out.shape
         out = out.transpose((0, 2, 1, 3)).reshape((b, t, h * d))
-        return self.proj(out), cache
+        return self._lora(self.proj(out), 3, layer_idx, out), cache
 
 
 class GPT2Block(HybridBlock):

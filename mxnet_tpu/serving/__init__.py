@@ -40,13 +40,26 @@ and drain()/rejoin() rolling restarts — with `ReplicaFaultPlan`
 injecting replica-level kill/hang/degrade for fleet-wide chaos
 (docs/SERVING.md "Multi-replica serving & failover").
 
+Multi-tenant serving (docs/SERVING.md "Multi-tenant LoRA serving"):
+`AdapterPool` (serving/adapters.py) pages per-layer low-rank (A, B)
+LoRA deltas for many registered adapters in and out of ONE
+device-resident slab, ref-counted and LRU-evicted like KV pages;
+`Request(adapter_id=, tenant=)` rides through admission, migration
+and restart, per-slot slab indices are runtime data inside the one
+compiled program (zero retraces across adapter churn), and
+`TenantQuota` + the scheduler's deficit-weighted fair pick keep one
+tenant from starving the rest.
+
 See docs/SERVING.md for the architecture and slot lifecycle.
 """
 from .sampling import filtered_logits, sample_tokens, slot_keys  # noqa: F401
 from .scheduler import (Request, SlotScheduler, RejectedError,  # noqa: F401
-                        QueueFullError, ShedError)
+                        QueueFullError, ShedError, TenantQuota,
+                        TenantQuotaError)
 from .page_pool import PagePool, PagePoolExhausted  # noqa: F401
 from .prefix_cache import PrefixCache  # noqa: F401
+from .adapters import (AdapterPool, AdapterPoolExhausted,  # noqa: F401
+                       merged_weights, random_lora)
 from .speculative import PromptLookupProposer, verify_tokens  # noqa: F401
 from .policy import SheddingPolicy  # noqa: F401
 from .faults import FaultError, FaultPlan, ReplicaFaultPlan  # noqa: F401
@@ -54,8 +67,11 @@ from .engine import ServingEngine  # noqa: F401
 from .router import ServingRouter  # noqa: F401
 
 __all__ = ["Request", "SlotScheduler", "RejectedError", "QueueFullError",
-           "ShedError", "ServingEngine", "ServingRouter",
+           "ShedError", "TenantQuota", "TenantQuotaError",
+           "ServingEngine", "ServingRouter",
            "SheddingPolicy", "PagePool", "PagePoolExhausted",
+           "AdapterPool", "AdapterPoolExhausted", "merged_weights",
+           "random_lora",
            "PrefixCache", "PromptLookupProposer", "FaultPlan",
            "FaultError", "ReplicaFaultPlan",
            "filtered_logits", "sample_tokens", "slot_keys",

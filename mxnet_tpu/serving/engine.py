@@ -84,11 +84,13 @@ from ..models.kv_cache import PagedKVCache
 from ..ndarray.ndarray import NDArray
 from ..telemetry import server as _tserver
 from ..telemetry import span
+from ..models.gpt2 import set_adapter_ctx as _set_adapter_ctx
+from .adapters import AdapterPoolExhausted
 from .page_pool import PagePool, PagePoolExhausted
 from .prefix_cache import PrefixCache
 from .sampling import sample_tokens, slot_keys
 from .scheduler import (QueueFullError, Request, ShedError,
-                        SlotScheduler, _seq_counter)
+                        SlotScheduler, TenantQuotaError, _seq_counter)
 from .speculative import PromptLookupProposer, verify_tokens
 
 __all__ = ["ServingEngine"]
@@ -215,8 +217,27 @@ def _engine_metrics(eid):
             "serving_retry_after_seconds",
             "drain-rate estimate of when a rejected submission could "
             "succeed (attached to shed / queue-full rejections)", _E),
+        "adapter_page_ins": c(
+            "serving_adapter_page_ins_total",
+            "LoRA adapters paged into the device slab (slab-slot scatter "
+            "on an acquire miss)", _E),
+        "adapter_evictions": c(
+            "serving_adapter_evictions_total",
+            "resident LoRA adapters LRU-evicted to make room for a "
+            "page-in (plus explicit evict() calls)", _E),
+        "adapter_resident": g(
+            "serving_adapter_resident",
+            "LoRA adapters currently resident in the device slab", _E),
+        "adapter_pinned": g(
+            "serving_adapter_pinned",
+            "slab slots pinned by active requests (unevictable)", _E),
+        "adapter_slab_bytes": g(
+            "serving_adapter_slab_bytes",
+            "device bytes held by the LoRA adapter slab (A + B + "
+            "scale)", _E),
     }
     _shed_family()                  # registered per-process; children
+    _tenant_families()
     return {k: inst.labels(eid) for k, inst in m.items()}
 
 
@@ -229,6 +250,31 @@ def _shed_family():
         "requests shed by the robustness layer, by reason (queue_full, "
         "overload, deadline, deadline_queued, deadline_running) and "
         "priority class", ("engine", "reason", "priority"))
+
+
+def _tenant_families():
+    """Per-tenant families (labeled {engine, tenant}); children are
+    created lazily as tenants appear in traffic, so an engine without
+    tenant_quotas pays nothing."""
+    return {
+        "admitted": telemetry.counter(
+            "serving_tenant_admitted_total",
+            "requests admitted to a decode slot, split by tenant",
+            ("engine", "tenant")),
+        "shed": telemetry.counter(
+            "serving_tenant_shed_total",
+            "requests shed or rejected, split by tenant and reason "
+            "(tenant_quota adds the per-tenant queue bound to the "
+            "engine-wide taxonomy)", ("engine", "tenant", "reason")),
+        "active": telemetry.gauge(
+            "serving_tenant_active_slots",
+            "decode slots currently held by each tenant",
+            ("engine", "tenant")),
+        "queued": telemetry.gauge(
+            "serving_tenant_queued",
+            "queued (admitted-but-waiting) requests per tenant",
+            ("engine", "tenant")),
+    }
 
 
 class ServingEngine:
@@ -276,7 +322,8 @@ class ServingEngine:
                  prefix_cache_pages=None, speculative=False,
                  spec_tokens=4, spec_max_ngram=3, spec_min_ngram=1,
                  num_priorities=3, policy=None, max_retries=3,
-                 retry_backoff_s=0.02, clock=None):
+                 retry_backoff_s=0.02, clock=None, adapter_pool=None,
+                 tenant_quotas=None):
         self.model = model
         cfg = model.config
         self.num_slots = int(num_slots)
@@ -309,7 +356,8 @@ class ServingEngine:
             # so drafting is schedule-independent
             self._hist = [None] * int(num_slots)
         self.scheduler = SlotScheduler(num_slots, max_queue=max_queue,
-                                       num_priorities=num_priorities)
+                                       num_priorities=num_priorities,
+                                       tenant_quotas=tenant_quotas)
         # robustness layer (docs/SERVING.md "Robustness"): supervisor
         # retry budget + backoff, optional shedding policy, and an
         # injectable clock so deadline/backoff behavior is testable
@@ -370,6 +418,14 @@ class ServingEngine:
         self._top_p = np.ones(B, np.float32)
         self._do_sample = np.zeros(B, bool)
         self._eos = np.full(B, -1, np.int32)
+        # multi-tenant LoRA (serving/adapters.py, docs/SERVING.md
+        # "Multi-tenant LoRA serving"): the pool's slab is device-
+        # resident; each slot carries its adapter's SLAB SLOT index as
+        # one more per-slot scalar (0 = null adapter = exact zeros), so
+        # adapter identity is runtime data — never a program shape axis
+        self.adapter_pool = adapter_pool
+        self._aslot = np.zeros(B, np.int32)
+        self._adapter_of = [None] * B   # slot -> pinned adapter_id
 
         self._prefill_programs = LRUTraceCache(
             max(2 * (max_length // self.prefill_bucket), 8))
@@ -392,10 +448,14 @@ class ServingEngine:
         # admission/finish/cancel (_sync_slot) — not ~12 small
         # jnp.asarray transfers on every dispatch
         self._upload_fn = self._build_slot_upload()
-        self._dstate = tuple(jnp.asarray(a) for a in (
-            self._lengths, self._cur_tok, self._done, self._remaining,
-            self._counters, self._seeds, self._temp, self._top_k,
-            self._top_p, self._do_sample, self._eos, self._table_host))
+        scalars = [self._lengths, self._cur_tok, self._done,
+                   self._remaining, self._counters, self._seeds,
+                   self._temp, self._top_k, self._top_p,
+                   self._do_sample, self._eos]
+        if self.adapter_pool is not None:
+            scalars.append(self._aslot)
+        self._dstate = tuple(jnp.asarray(a)
+                             for a in scalars + [self._table_host])
         self._d_lock = jnp.asarray(self._page_lock_host())
         self._eid = str(next(_engine_ids))
         self._metrics = _engine_metrics(self._eid)
@@ -403,6 +463,12 @@ class ServingEngine:
         self._shed = _shed_family()
         self._shed_children = {}   # (reason, priority) -> labeled child
         self._shed_counts = {}     # same keys, host-side for stats
+        self._tenant_fams = _tenant_families()
+        self._tenant_children = {}   # (family, tenant[, reason]) -> child
+        self._tenant_shed_counts = {}  # (tenant, reason) -> n
+        self._tenants_seen = set()
+        self._adapter_page_ins_seen = 0
+        self._adapter_evictions_seen = 0
         self._hook_kw_cache = None
         # a collected engine must not leave /healthz stuck degraded
         weakref.finalize(self, _tserver.clear_degraded,
@@ -475,7 +541,21 @@ class ServingEngine:
             "degraded": int(m["degraded"].value),
             "draining": self._draining,
             "shed": sum(self._shed_counts.values()),
+            "adapter_page_ins": int(m["adapter_page_ins"].value),
+            "adapter_evictions": int(m["adapter_evictions"].value),
+            "adapter_resident": int(m["adapter_resident"].value),
+            "adapter_pinned": int(m["adapter_pinned"].value),
         }
+
+    def tenant_stats(self):
+        """Per-tenant occupancy + lifetime accounting: the scheduler's
+        queued/active/admitted/quota view plus this engine's shed
+        taxonomy split by tenant. Keys are stringified tenant ids."""
+        out = self.scheduler.tenants_snapshot()
+        for (tenant, reason), n in sorted(self._tenant_shed_counts.items()):
+            row = out.setdefault(str(tenant), {})
+            row.setdefault("shed", {})[reason] = n
+        return out
 
     def reset_stats(self):
         """Zero this engine's telemetry children (other engines and the
@@ -485,10 +565,15 @@ class ServingEngine:
         for child in self._shed_children.values():
             child.reset()
         self._shed_counts = {}
+        for child in self._tenant_children.values():
+            child.reset()
+        self._tenant_shed_counts = {}
+        self._adapter_page_ins_seen = 0
+        self._adapter_evictions_seen = 0
         self._metrics["num_slots"].set(self.num_slots)
         self._set_pool_gauges()
 
-    def _shed_inc(self, reason, priority):
+    def _shed_inc(self, reason, priority, tenant=None):
         key = (reason, int(priority))
         child = self._shed_children.get(key)
         if child is None:
@@ -496,12 +581,51 @@ class ServingEngine:
             self._shed_children[key] = child
         child.inc()
         self._shed_counts[key] = self._shed_counts.get(key, 0) + 1
+        if tenant is not None:
+            self._tenant_child("shed", tenant, reason).inc()
+            tk = (tenant, reason)
+            self._tenant_shed_counts[tk] = \
+                self._tenant_shed_counts.get(tk, 0) + 1
+
+    def _tenant_child(self, family, tenant, reason=None):
+        key = (family, tenant) if reason is None \
+            else (family, tenant, reason)
+        child = self._tenant_children.get(key)
+        if child is None:
+            fam = self._tenant_fams[family]
+            child = fam.labels(self._eid, str(tenant)) if reason is None \
+                else fam.labels(self._eid, str(tenant), reason)
+            self._tenant_children[key] = child
+        self._tenants_seen.add(tenant)
+        return child
 
     def _set_load_gauges(self):
         self._metrics["queue_depth"].set(self.scheduler.num_queued)
         self._metrics["slot_occupancy"].set(self.scheduler.num_active)
         self._metrics["admission_capacity"].set(
             self.admission_capacity_estimate())
+        self._set_tenant_gauges()
+
+    def _set_tenant_gauges(self):
+        # one pass over the scheduler's queues/actives; zero the gauges
+        # of tenants seen earlier but absent now so they don't stick
+        sched = self.scheduler
+        if not sched.tenant_quotas and not self._tenants_seen:
+            return
+        queued, active = {}, {}
+        for q in sched._queues:
+            for req in q:
+                if req.tenant is not None:
+                    queued[req.tenant] = queued.get(req.tenant, 0) + 1
+        for req in sched._active.values():
+            if req.tenant is not None:
+                active[req.tenant] = active.get(req.tenant, 0) + 1
+        for t in (set(queued) | set(active) | set(sched.tenant_quotas)
+                  | self._tenants_seen):
+            if t is None:
+                continue
+            self._tenant_child("queued", t).set(queued.get(t, 0))
+            self._tenant_child("active", t).set(active.get(t, 0))
 
     def admission_capacity_estimate(self):
         """Max concurrent requests the current page budget supports:
@@ -530,6 +654,19 @@ class ServingEngine:
             if delta:
                 m["prefix_evicted_pages"].inc(delta)
                 self._evictions_seen = pc.evicted_pages
+        pool = self.adapter_pool
+        if pool is not None:
+            m["adapter_resident"].set(pool.num_resident)
+            m["adapter_pinned"].set(pool.num_pinned)
+            m["adapter_slab_bytes"].set(pool.slab_bytes())
+            delta = pool.page_ins - self._adapter_page_ins_seen
+            if delta:
+                m["adapter_page_ins"].inc(delta)
+                self._adapter_page_ins_seen = pool.page_ins
+            delta = pool.evictions - self._adapter_evictions_seen
+            if delta:
+                m["adapter_evictions"].inc(delta)
+                self._adapter_evictions_seen = pool.evictions
 
     def _statusz(self):
         """The /statusz + flight-recorder view of this engine: static
@@ -556,6 +693,11 @@ class ServingEngine:
                 "retry_backoff_s": self.retry_backoff_s,
                 "total_pages": self.page_pool.num_pages,
                 "steady_state": self._steady,
+                "adapter_pool": self.adapter_pool is not None,
+                "adapter_slots": self.adapter_pool.slots
+                if self.adapter_pool is not None else None,
+                "adapter_max_rank": self.adapter_pool.max_rank
+                if self.adapter_pool is not None else None,
             },
             "admission_capacity": self.admission_capacity_estimate(),
             "robustness": {
@@ -572,6 +714,9 @@ class ServingEngine:
                 "retry_after_s": self.estimated_queue_wait(),
             },
             "scheduler": self.scheduler.snapshot(),
+            "tenants": self.tenant_stats(),
+            "adapters": self.adapter_pool.snapshot()
+            if self.adapter_pool is not None else None,
             "prefix_hit_rate": s["prefix_hits"] / lookups
             if lookups else None,
             "spec_acceptance": s["spec_accepted_tokens"] / drafted
@@ -641,6 +786,9 @@ class ServingEngine:
             "kv_pages": [self._kp, self._vp],
             "slot_state": list(self._dstate) + [self._d_lock],
         }
+        pool = self.adapter_pool
+        if pool is not None:
+            out["adapter_slab"] = [pool.A, pool.B, pool.scale]
         # gluon-initialized params usually carry gradient buffers even
         # when only serving — account them so /memz reconciles
         grads = [g for g in (getattr(p._data, "_grad", None)
@@ -701,7 +849,7 @@ class ServingEngine:
             self._metrics["retry_after"].set(wait)
         request.status = "shed"
         self._metrics["requests_rejected"].inc()
-        self._shed_inc(reason, request.priority)
+        self._shed_inc(reason, request.priority, request.tenant)
         telemetry.request_log.terminal(
             request.id, self._eid, "rejected", reason=reason,
             priority=request.priority, prompt_len=request.prompt_len,
@@ -788,6 +936,18 @@ class ServingEngine:
             raise MXNetError(
                 f"prompt of {request.prompt_len} tokens exceeds slot "
                 f"capacity {self.max_length}")
+        if request.adapter_id not in (None, 0):
+            pool = self.adapter_pool
+            if pool is None or not pool.has(request.adapter_id):
+                self._metrics["requests_rejected"].inc()
+                telemetry.request_log.terminal(
+                    request.id, self._eid, "rejected",
+                    reason="unknown_adapter",
+                    adapter_id=str(request.adapter_id))
+                raise MXNetError(
+                    f"adapter {request.adapter_id!r} is not registered "
+                    + ("(engine has no adapter pool)" if pool is None
+                       else "with this engine's adapter pool"))
         if self._draining:
             self._reject(request, "draining")
         now = self._clock()
@@ -805,7 +965,9 @@ class ServingEngine:
         try:
             out = self.scheduler.submit(request)
         except QueueFullError as e:
-            self._reject(request, "queue_full", cause=e)
+            self._reject(request,
+                         "tenant_quota" if isinstance(e, TenantQuotaError)
+                         else "queue_full", cause=e)
         request.status = "queued"
         telemetry.request_log.begin(
             request.id, self._eid, prompt_len=request.prompt_len,
@@ -899,6 +1061,10 @@ class ServingEngine:
                 except Exception:   # noqa: BLE001
                     pass
                 self._free_slot_pages(slot)
+                try:
+                    self._release_adapter(slot)
+                except Exception:   # noqa: BLE001
+                    pass
             out.append(req)
         out.sort(key=lambda r: r._seq if r._seq is not None else -1)
         for req in out:
@@ -1050,7 +1216,7 @@ class ServingEngine:
         slot or page was ever touched."""
         req.status = "shed"
         req.t_finish = self._clock()
-        self._shed_inc("deadline_queued", req.priority)
+        self._shed_inc("deadline_queued", req.priority, req.tenant)
         telemetry.request_log.end(
             req.id, self._eid, "rejected", reason="deadline",
             queued=True, tokens=0)
@@ -1063,7 +1229,7 @@ class ServingEngine:
         `finished(deadline)`."""
         req = self._release_slot(slot)
         req.status = "deadline"
-        self._shed_inc("deadline_running", req.priority)
+        self._shed_inc("deadline_running", req.priority, req.tenant)
         telemetry.request_log.end(
             req.id, self._eid, "finished", reason="deadline",
             tokens=len(req.output_tokens))
@@ -1086,13 +1252,26 @@ class ServingEngine:
         return self.page_pool.audit(leases=leases, members=members,
                                     raise_on_error=raise_on_error)
 
+    def audit_adapters(self, raise_on_error=False):
+        """Adapter-pool invariant audit with this engine's slot
+        assignments: every active slot's pinned adapter must be
+        resident with a pin count that matches the assignment count
+        exactly (a leaked pin would wedge the slab). Returns the
+        violation list ([] = clean; also [] without a pool)."""
+        if self.adapter_pool is None:
+            return []
+        assignments = [aid for aid in self._adapter_of if aid is not None]
+        return self.adapter_pool.audit(assignments=assignments,
+                                       raise_on_error=raise_on_error)
+
     def _audit_and_latch(self, phase, exc):
-        """Post-fault integrity check: run the page-pool audit while
-        the implicated slots still hold their leases (so the lease map
-        is complete) and latch a flight-recorder dump naming the
-        fault. Returns the violation list (normally empty — the fault
-        was caught BEFORE any accounting was rolled back)."""
-        violations = self.audit_pages()
+        """Post-fault integrity check: run the page-pool AND
+        adapter-pool audits while the implicated slots still hold their
+        leases/pins (so the maps are complete) and latch a
+        flight-recorder dump naming the fault. Returns the violation
+        list (normally empty — the fault was caught BEFORE any
+        accounting was rolled back)."""
+        violations = self.audit_pages() + self.audit_adapters()
         detail = f"{phase}: {type(exc).__name__}: {exc}"
         if violations:
             detail += " | audit: " + "; ".join(violations)
@@ -1155,9 +1334,11 @@ class ServingEngine:
         Request, or None."""
         now = self._clock()
         self._metrics["dispatch_errors"].inc()
-        backpressure = isinstance(exc, PagePoolExhausted)
+        backpressure = isinstance(exc, (PagePoolExhausted,
+                                        AdapterPoolExhausted))
         self.scheduler.release(slot)
         self._free_slot_pages(slot)
+        self._release_adapter(slot)
         self._done[slot] = True
         self._remaining[slot] = 0
         self._lengths[slot] = self.max_length
@@ -1265,9 +1446,24 @@ class ServingEngine:
                 self._counters[slot], self._seeds[slot],
                 self._temp[slot], self._top_k[slot], self._top_p[slot],
                 self._do_sample[slot], self._eos[slot])
+        if self.adapter_pool is not None:
+            vals = vals + (self._aslot[slot],)
         self._dstate = self._upload_fn(self._dstate, np.int32(slot),
                                        vals, self._table_host[slot])
         self._d_lock = jnp.asarray(self._page_lock_host())
+
+    def _adapter_args(self, aslot):
+        """The extra dispatch operands when the adapter pool is on: the
+        slab-slot index array plus the slab itself (read-only — never
+        donated, so page-ins and dispatches interleave freely). () when
+        the pool is off, keeping the dispatch signature — and the trace
+        — byte-identical to a pre-adapter engine."""
+        pool = self.adapter_pool
+        if pool is None:
+            return ()
+        if isinstance(aslot, tuple):    # the _dstate tail
+            aslot = aslot[0]
+        return (aslot, pool.A, pool.B, pool.scale)
 
     # -- pages -------------------------------------------------------------
     def _page_lock_host(self):
@@ -1345,9 +1541,19 @@ class ServingEngine:
         model, params = self.model, self._params
 
         def prefill(param_arrays, kp, vp, ids, row, offset, true_len,
-                    counter0, seed, temp, top_k, top_p, do_sample, eos):
+                    counter0, seed, temp, top_k, top_p, do_sample, eos,
+                    *adapter):
+            # `adapter` is () (pool disabled: the trace is byte-identical
+            # to the pre-adapter program) or (aslot, A, B, scale): the
+            # slot's slab index is traced DATA — any adapter mix reuses
+            # this one program
             saved = [p._data for p in params]
             _trace_channel.push_frame()
+            prev_ctx = None
+            if adapter:
+                aslot, a_A, a_B, a_scale = adapter
+                prev_ctx = _set_adapter_ctx(
+                    (a_A, a_B, a_scale, aslot[None]))
             try:
                 for p, d in zip(params, param_arrays):
                     arr = NDArray(d)
@@ -1361,6 +1567,8 @@ class ServingEngine:
                                      attn_impl=self.attn_impl)
                 logits, cache = model.forward(NDArray(ids), cache)
             finally:
+                if adapter:
+                    _set_adapter_ctx(prev_ctx)
                 _trace_channel.pop_frame()
                 for p, d in zip(params, saved):
                     p._data = d
@@ -1393,8 +1601,19 @@ class ServingEngine:
             telemetry.request_log.event(
                 req.id, self._eid, "resumed", tokens=base)
         self._fire_hook("prefill", (req,))
+        if self.adapter_pool is not None:
+            # pin BEFORE the page map: either acquire can raise
+            # (AdapterPoolExhausted is backpressure, like
+            # PagePoolExhausted) and _on_admit_fault rolls back
+            # whatever was taken
+            aslot = self.adapter_pool.acquire(req.adapter_id)
+            self._adapter_of[slot] = req.adapter_id \
+                if req.adapter_id not in (None, 0) else None
+            self._aslot[slot] = aslot
         offset = self._map_slot_pages(slot, tokens)
         req.status = "running"
+        if req.tenant is not None:
+            self._tenant_child("admitted", req.tenant).inc()
         if self.prefix_cache is not None:
             telemetry.request_log.event(
                 req.id, self._eid, "prefix_match", cached_tokens=offset)
@@ -1419,7 +1638,9 @@ class ServingEngine:
                 jnp.asarray(req.temperature, jnp.float32),
                 i32(req.top_k), jnp.asarray(req.top_p, jnp.float32),
                 jnp.asarray(req.do_sample), i32(
-                    -1 if req.eos_token_id is None else req.eos_token_id))
+                    -1 if req.eos_token_id is None
+                    else req.eos_token_id),
+                *self._adapter_args(i32(self._aslot[slot])))
             self._kp, self._vp = kp, vp
             first = int(first)      # host sync: the prefill is done here
         now = self._clock()
@@ -1456,6 +1677,7 @@ class ServingEngine:
             if n_full:
                 pc.insert(req.prompt,
                           [int(p) for p in self._table_host[slot][:n_full]])
+        if pc is not None or self.adapter_pool is not None:
             self._set_pool_gauges()
         # budget: every decode step writes one KV; the last sampled token
         # is never written, so a sequence of Tp supports up to
@@ -1511,9 +1733,13 @@ class ServingEngine:
 
         def decode(param_arrays, kp, vp, table, lock, lengths, cur_tok,
                    done, remaining, counters, seeds, temp, top_k, top_p,
-                   do_sample, eos):
+                   do_sample, eos, *adapter):
             saved = [p._data for p in params]
             _trace_channel.push_frame()
+            prev_ctx = None
+            if adapter:
+                aslot, a_A, a_B, a_scale = adapter
+                prev_ctx = _set_adapter_ctx((a_A, a_B, a_scale, aslot))
             try:
                 for p, d in zip(params, param_arrays):
                     arr = NDArray(d)
@@ -1561,6 +1787,8 @@ class ServingEngine:
                 final, (toks, valid) = lax.scan(body, init, None,
                                                 length=K)
             finally:
+                if adapter:
+                    _set_adapter_ctx(prev_ctx)
                 _trace_channel.pop_frame()
                 for p, d in zip(params, saved):
                     p._data = d
@@ -1576,20 +1804,23 @@ class ServingEngine:
                          for s in self.scheduler.active_slots])
         fn = self._decode_fn(False)
         param_datas = tuple(p.data()._data for p in self._params)
+        st = self._dstate
         (lengths, cur_tok, done, remaining, counters, seeds, temp,
-         top_k, top_p, do_sample, eos, table) = self._dstate
+         top_k, top_p, do_sample, eos) = st[:11]
+        tail, table = st[11:-1], st[-1]   # (aslot,) with the pool on
         t0 = self._clock()
         with span("serving.decode_block", engine=self._eid,
                   active=self.scheduler.num_active):
             out = fn(
                 param_datas, self._kp, self._vp, table, self._d_lock,
                 lengths, cur_tok, done, remaining, counters, seeds,
-                temp, top_k, top_p, do_sample, eos)
+                temp, top_k, top_p, do_sample, eos,
+                *self._adapter_args(tail))
             (self._kp, self._vp, lengths, cur_tok, done, remaining,
              counters, okc, toks, valid) = out
             self._dstate = (lengths, cur_tok, done, remaining, counters,
-                            seeds, temp, top_k, top_p, do_sample, eos,
-                            table)
+                            seeds, temp, top_k, top_p, do_sample,
+                            eos) + tail + (table,)
             # ONE host sync per K decoded tokens: everything small fetches
             # together (the pools stay on device, donated through)
             (self._lengths, self._cur_tok, self._done, self._remaining,
@@ -1654,9 +1885,13 @@ class ServingEngine:
 
         def decode(param_arrays, kp, vp, table, lock, lengths, cur_tok,
                    done, remaining, counters, drafts, n_draft, seeds,
-                   temp, top_k, top_p, do_sample, eos):
+                   temp, top_k, top_p, do_sample, eos, *adapter):
             saved = [p._data for p in params]
             _trace_channel.push_frame()
+            prev_ctx = None
+            if adapter:
+                aslot, a_A, a_B, a_scale = adapter
+                prev_ctx = _set_adapter_ctx((a_A, a_B, a_scale, aslot))
             try:
                 for p, d in zip(params, param_arrays):
                     arr = NDArray(d)
@@ -1708,6 +1943,8 @@ class ServingEngine:
                 new_cnt = jnp.where(active, counters + n_em, counters)
                 n_acc_em = jnp.minimum(n_acc, n_em)   # drafts EMITTED
             finally:
+                if adapter:
+                    _set_adapter_ctx(prev_ctx)
                 _trace_channel.pop_frame()
                 for p, d in zip(params, saved):
                     p._data = d
@@ -1730,8 +1967,10 @@ class ServingEngine:
             n_draft[slot] = d.size
             drafts[slot, :d.size] = d
         param_datas = tuple(p.data()._data for p in self._params)
+        st = self._dstate
         (lengths, cur_tok, done, remaining, counters, seeds, temp,
-         top_k, top_p, do_sample, eos, table) = self._dstate
+         top_k, top_p, do_sample, eos) = st[:11]
+        tail, table = st[11:-1], st[-1]   # (aslot,) with the pool on
         t0 = self._clock()
         with span("serving.spec_decode", engine=self._eid,
                   active=self.scheduler.num_active,
@@ -1740,12 +1979,13 @@ class ServingEngine:
                 param_datas, self._kp, self._vp, table, self._d_lock,
                 lengths, cur_tok, done, remaining, counters,
                 jnp.asarray(drafts), jnp.asarray(n_draft), seeds, temp,
-                top_k, top_p, do_sample, eos)
+                top_k, top_p, do_sample, eos,
+                *self._adapter_args(tail))
             (self._kp, self._vp, lengths, cur_tok, done, remaining,
              counters, okc, toks, n_em, n_acc) = out
             self._dstate = (lengths, cur_tok, done, remaining, counters,
-                            seeds, temp, top_k, top_p, do_sample, eos,
-                            table)
+                            seeds, temp, top_k, top_p, do_sample,
+                            eos) + tail + (table,)
             (self._lengths, self._cur_tok, self._done, self._remaining,
              self._counters) = (
                 np.array(lengths), np.array(cur_tok), np.array(done),
@@ -1813,10 +2053,23 @@ class ServingEngine:
         self._remaining[slot] = 0
         self._lengths[slot] = self.max_length
         self._free_slot_pages(slot)
+        self._release_adapter(slot)
         if self.speculative:
             self._hist[slot] = None
         self._sync_slot(slot)
         return req
+
+    def _release_adapter(self, slot):
+        """Drop the slot's adapter pin (no-op without a pool or for the
+        null adapter) and park the slot on slab slot 0 so the next
+        _sync_slot uploads a null-adapter row."""
+        if self.adapter_pool is None:
+            return
+        aid = self._adapter_of[slot]
+        if aid is not None:
+            self.adapter_pool.release(aid)
+            self._adapter_of[slot] = None
+        self._aslot[slot] = 0
 
     def _finish(self, slot):
         # read the stop cause BEFORE release zeroes the slot state:

@@ -61,12 +61,17 @@ class SheddingPolicy:
         elevated or worse — the estimate is noise when idle).
     degrade_after / recover_after: consecutive step ticks at/below
         level 2 that latch / clear graceful degradation.
+    tenant_queue_share: while elevated or worse, shed a request whose
+        tenant already holds more than this fraction of the queue
+        (ShedError reason="tenant_share") — one tenant's burst must
+        not starve the others of queue capacity. None disables the
+        signal; it only ever fires for requests that carry a tenant.
     """
 
     def __init__(self, ttft_slo_ms=None, queue_low=None, queue_high=None,
                  shed_priority_floor=0, min_ttft_samples=8,
                  deadline_headroom=1.0, degrade_after=3,
-                 recover_after=6):
+                 recover_after=6, tenant_queue_share=None):
         self.ttft_slo_ms = ttft_slo_ms
         self.queue_low = queue_low
         self.queue_high = queue_high
@@ -75,6 +80,11 @@ class SheddingPolicy:
         self.deadline_headroom = float(deadline_headroom)
         self.degrade_after = int(degrade_after)
         self.recover_after = int(recover_after)
+        self.tenant_queue_share = None if tenant_queue_share is None \
+            else float(tenant_queue_share)
+        if self.tenant_queue_share is not None \
+                and not 0.0 < self.tenant_queue_share <= 1.0:
+            raise ValueError("tenant_queue_share must be in (0, 1]")
         self._hot = 0              # consecutive overloaded ticks
         self._cool = 0             # consecutive non-overloaded ticks
         self.level = 0
@@ -128,6 +138,12 @@ class SheddingPolicy:
             if wait is not None and request.deadline_ms / 1e3 \
                     < self.deadline_headroom * wait:
                 return "shed", "deadline"
+        if level >= 1 and self.tenant_queue_share is not None \
+                and request.tenant is not None:
+            q = engine.scheduler.num_queued
+            mine = engine.scheduler.tenant_queued(request.tenant)
+            if q and mine / q > self.tenant_queue_share:
+                return "shed", "tenant_share"
         if level >= 1 and request.priority >= 1 \
                 and request.priority < engine.scheduler.num_priorities - 1:
             request.priority += 1
@@ -162,6 +178,7 @@ class SheddingPolicy:
             "deadline_headroom": self.deadline_headroom,
             "degrade_after": self.degrade_after,
             "recover_after": self.recover_after,
+            "tenant_queue_share": self.tenant_queue_share,
             "level": self.level,
             "downgrades": self.downgrades,
         }

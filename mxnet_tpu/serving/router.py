@@ -283,9 +283,13 @@ class ServingRouter:
         """Rendezvous (highest-random-weight) hash of the prompt's
         first page of tokens over the candidate replicas: deterministic
         for a given prefix, and stable — a replica leaving the set only
-        moves the keys it owned."""
+        moves the keys it owned. The adapter id folds into the key so
+        same-adapter traffic co-locates and replicas don't each page in
+        every adapter; null-adapter requests hash exactly as before."""
         key = np.asarray(request.prompt[:self._affinity_tokens],
                          np.int32).tobytes()
+        if request.adapter_id not in (None, 0):
+            key += b"|adapter:" + repr(request.adapter_id).encode()
         best, best_w = None, -1
         for i in candidates:
             w = zlib.crc32(key + b"/%d" % i)
@@ -621,7 +625,8 @@ class ServingRouter:
                 temperature=req.temperature, top_k=req.top_k,
                 top_p=req.top_p, seed=req.seed,
                 eos_token_id=req.eos_token_id, priority=req.priority,
-                deadline_ms=req.deadline_ms)
+                deadline_ms=req.deadline_ms, adapter_id=req.adapter_id,
+                tenant=req.tenant)
             try:
                 self.replicas[tgt].engine.submit(clone)
             except RejectedError:

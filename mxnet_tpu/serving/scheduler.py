@@ -34,8 +34,8 @@ import numpy as np
 
 from ..base import MXNetError
 
-__all__ = ["Request", "SlotScheduler", "RejectedError", "QueueFullError",
-           "ShedError"]
+__all__ = ["Request", "SlotScheduler", "TenantQuota", "RejectedError",
+           "QueueFullError", "TenantQuotaError", "ShedError"]
 
 _req_counter = itertools.count()
 _seq_counter = itertools.count()
@@ -57,11 +57,19 @@ class Request:
     `rejected(deadline)`); a running one is cancelled at the next
     dispatch boundary (terminal `finished(deadline)`, partial output
     kept). None = no deadline.
+
+    adapter_id: LoRA adapter this request decodes through (must be
+    registered in the engine's AdapterPool); None/0 = the base model
+    (null adapter, bit-identical to an adapter-free engine).
+    tenant: accounting/quota label for multi-tenant admission; None =
+    the anonymous default tenant. Both ride along through migration
+    (export/adopt) and restart continuations.
     """
 
     def __init__(self, prompt, max_new_tokens, request_id=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
-                 seed=0, eos_token_id=None, priority=1, deadline_ms=None):
+                 seed=0, eos_token_id=None, priority=1, deadline_ms=None,
+                 adapter_id=None, tenant=None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size == 0:
             raise MXNetError("Request needs a non-empty prompt")
@@ -86,6 +94,8 @@ class Request:
             raise MXNetError("priority must be >= 0 (0 = most urgent)")
         self.deadline_ms = None if deadline_ms is None \
             else float(deadline_ms)
+        self.adapter_id = adapter_id
+        self.tenant = tenant
         # filled in by the engine
         self.status = "new"
         self.output_tokens = []
@@ -136,10 +146,54 @@ class QueueFullError(RejectedError):
     retry-after estimate attached."""
 
 
+class TenantQuotaError(QueueFullError):
+    """Raised by SlotScheduler.submit when the request's TENANT is at
+    its max_queue quota (its priority-class queue may have room) — a
+    subclass of QueueFullError so front-ends that only know the class
+    bound still see backpressure, but the engine counts it under its
+    own shed reason (serving_shed_total{reason="tenant_quota"})."""
+
+    def __init__(self, msg, tenant=None, **kw):
+        super().__init__(msg, **kw)
+        self.tenant = tenant
+
+
 class ShedError(RejectedError):
     """Raised by the engine when the shedding policy refuses a request
     before it queues (overload, infeasible deadline) — counted in
     serving_shed_total{reason,priority}."""
+
+
+class TenantQuota:
+    """Per-tenant admission limits + fair-share weight.
+
+    max_active: concurrent decode slots the tenant may hold (None =
+    no cap — the tenant competes for everything). A tenant at its cap
+    keeps its requests QUEUED (not shed): the cap bounds slot
+    occupancy, the queue bound sheds.
+    max_queue: queued requests across all priority classes (None =
+    only the per-class bounds apply). Submissions past it raise
+    TenantQuotaError — countable backpressure, the multi-tenant
+    analogue of queue_full.
+    weight: deficit-weighted fair-share weight inside the pick loop;
+    a weight-2 tenant is owed twice the admissions of a weight-1
+    tenant when both have eligible queued work.
+    """
+
+    def __init__(self, max_active=None, max_queue=None, weight=1.0):
+        if max_active is not None and max_active < 1:
+            raise MXNetError("max_active must be >= 1 (or None)")
+        if max_queue is not None and max_queue < 1:
+            raise MXNetError("max_queue must be >= 1 (or None)")
+        if weight <= 0:
+            raise MXNetError("weight must be > 0")
+        self.max_active = None if max_active is None else int(max_active)
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.weight = float(weight)
+
+    def __repr__(self):
+        return (f"TenantQuota(max_active={self.max_active}, "
+                f"max_queue={self.max_queue}, weight={self.weight})")
 
 
 class SlotScheduler:
@@ -153,7 +207,7 @@ class SlotScheduler:
     starvation-freedom cadence (every Nth admission is oldest-first)."""
 
     def __init__(self, num_slots, max_queue=None, num_priorities=3,
-                 aging_every=4):
+                 aging_every=4, tenant_quotas=None):
         if num_slots < 1:
             raise MXNetError("need at least one decode slot")
         self.num_slots = int(num_slots)
@@ -182,6 +236,17 @@ class SlotScheduler:
         self._queues = [deque() for _ in range(self.num_priorities)]
         self._active = {}          # slot -> Request
         self._admitted = 0         # total admissions, drives aging
+        # multi-tenant admission: {tenant: TenantQuota}. Tenants
+        # without an entry (and tenant=None traffic) are unquoted with
+        # weight 1 — single-tenant behaviour is unchanged.
+        quotas = tenant_quotas or {}
+        for t, q in quotas.items():
+            if not isinstance(q, TenantQuota):
+                raise MXNetError(f"tenant_quotas[{t!r}] must be a "
+                                 "TenantQuota")
+        self.tenant_quotas = dict(quotas)
+        self._tenant_service = {}  # tenant -> weighted admissions
+        self._tenant_admitted = {}  # tenant -> raw admissions (stats)
 
     @property
     def max_queue(self):
@@ -191,6 +256,20 @@ class SlotScheduler:
         if all(b == first for b in self._bounds):
             return first
         return list(self._bounds)
+
+    # -- tenants -----------------------------------------------------------
+    def quota_of(self, tenant):
+        return self.tenant_quotas.get(tenant)
+
+    def tenant_queued(self, tenant):
+        return sum(r.tenant == tenant for q in self._queues for r in q)
+
+    def tenant_active(self, tenant):
+        return sum(r.tenant == tenant for r in self._active.values())
+
+    def _weight(self, tenant):
+        q = self.tenant_quotas.get(tenant)
+        return q.weight if q is not None else 1.0
 
     # -- queue -------------------------------------------------------------
     def submit(self, request):
@@ -204,6 +283,17 @@ class SlotScheduler:
                 f"({bound} waiting); rejecting request — retry after "
                 "the queue drains",
                 reason="queue_full", queue_depth=self.num_queued,
+                active_slots=self.num_active, priority=pr)
+        tenant = getattr(request, "tenant", None)
+        quota = self.tenant_quotas.get(tenant)
+        if quota is not None and quota.max_queue is not None \
+                and self.tenant_queued(tenant) >= quota.max_queue:
+            raise TenantQuotaError(
+                f"tenant {tenant!r} is at its queue quota "
+                f"({quota.max_queue} waiting); rejecting request — "
+                "this tenant must drain before submitting more",
+                reason="tenant_quota", tenant=tenant,
+                queue_depth=self.num_queued,
                 active_slots=self.num_active, priority=pr)
         request._seq = next(_seq_counter)
         self._queues[pr].append(request)
@@ -237,6 +327,11 @@ class SlotScheduler:
             return False             # one probationer in flight at a time
         if now is not None and req.t_not_before > now:
             return False             # still backing off
+        quota = self.tenant_quotas.get(req.tenant)
+        if quota is not None and quota.max_active is not None \
+                and self.tenant_active(req.tenant) >= quota.max_active:
+            return False             # tenant at its slot cap: stays
+            # queued (the cap bounds occupancy; the queue bound sheds)
         return True
 
     def _pick(self, now):
@@ -244,7 +339,8 @@ class SlotScheduler:
                            for r in self._active.values())
         if (self._admitted + 1) % self.aging_every == 0:
             # aging turn: globally oldest eligible request wins,
-            # whatever its class
+            # whatever its class or tenant — starvation-freedom
+            # outranks fair share
             best = None
             for ci, q in enumerate(self._queues):
                 for pos, req in enumerate(q):
@@ -258,10 +354,27 @@ class SlotScheduler:
                 return req
             return None
         for q in self._queues:
+            # deficit-weighted fair pick inside the class: each
+            # contending tenant's oldest eligible request is a
+            # candidate; the tenant with the least weighted service
+            # wins (ties → FIFO by _seq). With one tenant (or none
+            # configured) every candidate is the queue head — plain
+            # FIFO, the pre-tenant behaviour.
+            heads = {}               # tenant -> (pos, req), oldest
             for pos, req in enumerate(q):
-                if self._eligible(req, now, probe_ok):
-                    del q[pos]
-                    return req
+                if req.tenant not in heads \
+                        and self._eligible(req, now, probe_ok):
+                    heads[req.tenant] = (pos, req)
+            if not heads:
+                continue
+            pos, req = min(
+                heads.values(),
+                key=lambda pr: (
+                    self._tenant_service.get(pr[1].tenant, 0.0)
+                    / self._weight(pr[1].tenant),
+                    pr[1]._seq))
+            del q[pos]
+            return req
         return None
 
     def admit(self, now=None):
@@ -278,6 +391,10 @@ class SlotScheduler:
             slot = self._free.popleft()
             self._active[slot] = req
             self._admitted += 1
+            self._tenant_service[req.tenant] = \
+                self._tenant_service.get(req.tenant, 0.0) + 1.0
+            self._tenant_admitted[req.tenant] = \
+                self._tenant_admitted.get(req.tenant, 0) + 1
             admitted.append((slot, req))
         return admitted
 
@@ -337,11 +454,36 @@ class SlotScheduler:
                     "request_id": req.id,
                     "prompt_len": req.prompt_len,
                     "priority": req.priority,
+                    "tenant": req.tenant,
+                    "adapter_id": req.adapter_id,
                     "generated": len(req.output_tokens),
                     "max_new_tokens": req.max_new_tokens,
                     "dispatch_failures": req.dispatch_failures,
                 } for slot, req in sorted(self._active.items())},
+            "tenants": self.tenants_snapshot(),
         }
+
+    def tenants_snapshot(self):
+        """Per-tenant quota occupancy — the /statusz tenants block.
+        Covers every tenant with a configured quota plus any tenant
+        that currently has queued/active work or has ever been
+        admitted."""
+        tenants = set(self.tenant_quotas)
+        tenants.update(r.tenant for q in self._queues for r in q)
+        tenants.update(r.tenant for r in self._active.values())
+        tenants.update(self._tenant_admitted)
+        out = {}
+        for t in sorted(tenants, key=lambda x: (x is None, str(x))):
+            quota = self.tenant_quotas.get(t)
+            out[str(t)] = {
+                "queued": self.tenant_queued(t),
+                "active": self.tenant_active(t),
+                "admitted": self._tenant_admitted.get(t, 0),
+                "max_active": quota.max_active if quota else None,
+                "max_queue": quota.max_queue if quota else None,
+                "weight": self._weight(t),
+            }
+        return out
 
     @property
     def active_slots(self):

@@ -492,3 +492,62 @@ def test_chaos_soak_poisson_arrivals_bit_identical():
     assert eng.stats["requests_failed"] == len(poison)
     assert eng.stats["dispatch_errors"] >= 1
     assert eng.audit_pages() == []
+
+
+def test_chaos_soak_with_adapters_keeps_both_pools_clean():
+    """Adapter-enabled chaos: faults during prefill/decode must roll
+    adapter pins back exactly like page leases — at drain BOTH audits
+    are clean and every non-poison output is bit-identical to the
+    fault-free adapter run."""
+    from mxnet_tpu.serving import AdapterPool, random_lora
+    N = 48
+    names = ["fa", "fb", "fc", None]      # mixed wear, incl. null
+
+    def mk():
+        rng = np.random.default_rng(17)
+        return [Request(rng.integers(1, 97,
+                                     size=int(rng.integers(2, 10))),
+                        int(rng.integers(2, 6)), request_id=f"a{i}",
+                        adapter_id=names[i % len(names)],
+                        tenant=f"t{i % 2}")
+                for i in range(N)]
+
+    net, cfg = _tiny(max_len=64)
+
+    def mk_engine(**kw):
+        pool = AdapterPool(cfg, slots=3, max_rank=2)  # 2 usable slots
+        for j, name in enumerate(n for n in names if n):
+            pool.register(name, random_lora(cfg, rank=2, seed=40 + j,
+                                            scale=0.05))
+        return _engine(net, num_slots=4, max_length=64,
+                       adapter_pool=pool, **kw), pool
+
+    base_eng, _ = mk_engine()
+    want = _outputs(base_eng.serve(mk()))
+
+    eng, pool = mk_engine(max_retries=8, retry_backoff_s=0.0)
+    plan = FaultPlan(seed=5, dispatch_exception=0.05, nan_logits=0.05,
+                     pool_exhaustion=0.05, exhaust_steps=2,
+                     max_faults=25)
+    plan.install(eng)
+    arrivals = np.random.default_rng(19)
+    pending = mk()[::-1]
+    done, steps = [], 0
+    try:
+        while (pending or eng.has_work) and steps < 20000:
+            for _ in range(int(arrivals.poisson(3.0))):
+                if pending:
+                    eng.submit(pending.pop())
+            done.extend(eng.step())
+            steps += 1
+    finally:
+        plan.uninstall()
+    while eng.has_work and steps < 20000:
+        done.extend(eng.step())
+        steps += 1
+    assert steps < 20000, "adapter chaos soak did not converge"
+    assert _outputs(done) == want
+    assert eng.audit_pages() == []
+    assert eng.audit_adapters() == []
+    assert pool.num_pinned == 0           # every fault path unpinned
+    assert eng.stats["dispatch_errors"] >= 1

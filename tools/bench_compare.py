@@ -41,7 +41,8 @@ import sys
 __all__ = ["load_records", "compare", "main"]
 
 _LOWER_BETTER = ("latency", "_ms", "seconds", "bytes", "loss",
-                 "overhead", "ttft", "mismatch")
+                 "overhead", "ttft", "mismatch", "page_in", "eviction",
+                 "compiles", "shed")
 
 
 def lower_is_better(name):

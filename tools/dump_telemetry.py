@@ -14,6 +14,7 @@ Usage:
     python tools/dump_telemetry.py --serve 9100 --linger 60
     python tools/dump_telemetry.py --cost     # MFU/roofline/compile
     python tools/dump_telemetry.py --shed     # load-shedding headline
+    python tools/dump_telemetry.py --tenants  # multi-tenant headline
     python tools/dump_telemetry.py --router   # multi-replica headline
 
 --trace writes the run's request timelines + spans as Chrome
@@ -150,6 +151,50 @@ def run_router():
     return router
 
 
+def run_tenants():
+    """A multi-tenant engine: more registered adapters than slab
+    slots, three tenants with one pushed past its queue quota — so
+    the serving_adapter_* / serving_tenant_* instruments carry real
+    values in the dump."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import GPT2Config, GPT2ForCausalLM
+    from mxnet_tpu.serving import (AdapterPool, RejectedError, Request,
+                                   ServingEngine, TenantQuota,
+                                   random_lora)
+
+    cfg = GPT2Config(vocab_size=97, units=32, num_layers=2, num_heads=2,
+                     max_length=64, dropout=0.0, attention_dropout=0.0)
+    net = GPT2ForCausalLM(cfg)
+    mx.rng.seed(0)
+    net.initialize(mx.init.Normal(0.05))
+    pool = AdapterPool(cfg, slots=3, max_rank=2)   # 2 usable slots
+    adapters = [f"ft{i}" for i in range(4)]        # > usable slots
+    for i, name in enumerate(adapters):
+        pool.register(name, random_lora(cfg, rank=2, seed=20 + i,
+                                        scale=0.05))
+    eng = ServingEngine(
+        net, num_slots=2, max_length=32, page_size=8, decode_block=2,
+        attn_impl="xla", adapter_pool=pool,
+        tenant_quotas={"hog": TenantQuota(max_active=1, max_queue=2),
+                       "calm": TenantQuota(weight=2.0)})
+    rng = np.random.default_rng(0)
+    tenants = ["hog", "hog", "hog", "calm", "free"]
+    shed = 0
+    for i in range(12):
+        r = Request(rng.integers(1, cfg.vocab_size, 5).tolist(), 4,
+                    request_id=600 + i, tenant=tenants[i % len(tenants)],
+                    adapter_id=adapters[i % len(adapters)])
+        try:
+            eng.submit(r)
+        except RejectedError:
+            shed += 1
+    while eng.has_work:
+        eng.step()
+    return eng
+
+
 def run_training():
     import numpy as np
 
@@ -190,6 +235,10 @@ def main():
                     help="also run an overloaded engine (tight "
                          "watermarks, mixed-priority deadline burst) "
                          "and print the load-shedding headline")
+    ap.add_argument("--tenants", action="store_true",
+                    help="also run a multi-tenant LoRA engine (paged "
+                         "adapter slab + tenant quotas) and print the "
+                         "per-tenant headline")
     ap.add_argument("--router", action="store_true",
                     help="also run a two-replica router with hedging "
                          "and a seeded mid-run replica kill and print "
@@ -211,12 +260,14 @@ def main():
               "(/metrics /statusz /requests /trace /healthz)")
     if args.spans:
         telemetry.enable_jsonl(args.spans)
-    eng = spec = shed_eng = router = None
+    eng = spec = shed_eng = router = tenant_eng = None
     with telemetry.span("dump_telemetry.workloads"):
         if args.workload in ("serving", "both"):
             eng, spec = run_serving()
         if args.shed:
             shed_eng = run_shedding()
+        if args.tenants:
+            tenant_eng = run_tenants()
         if args.router:
             router = run_router()
         if args.workload in ("training", "both"):
@@ -263,6 +314,23 @@ def main():
               f"overload level {rb['overload_level']}, "
               f"degraded {'yes' if rb['degraded'] else 'no'}, "
               f"downgrades {rb['policy']['downgrades']}")
+    if tenant_eng is not None:
+        # the multi-tenant headline: per-tenant fairness outcomes plus
+        # how hard the adapter slab is paging
+        s = tenant_eng.stats
+        pool = tenant_eng.adapter_pool
+        per = ", ".join(
+            f"{t}[admitted {v.get('admitted', 0)}, "
+            f"shed {sum(v.get('shed', {}).values())}, "
+            f"active {v.get('active', 0)}]"
+            for t, v in sorted(tenant_eng.tenant_stats().items()))
+        page_rate = pool.page_ins / max(s["prefills"], 1)
+        print(f"# tenants: {per or 'none'}")
+        print(f"# adapters: resident {pool.num_resident}/"
+              f"{pool.slots - 1} slots, registered "
+              f"{pool.num_registered}, page-ins {pool.page_ins} "
+              f"({page_rate:.2f}/prefill), evictions {pool.evictions}, "
+              f"slab {pool.slab_bytes() / 1024:.1f} KiB")
     if router is not None:
         # the multi-replica headline: placement quality, failover and
         # hedging outcomes, and where each replica stands right now
