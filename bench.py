@@ -381,10 +381,10 @@ def bench_gpt2_serving():
 
     eng = ServingEngine(net, num_slots=slots, max_length=max_len,
                         page_size=page, decode_block=block)
-    # warmup: compile the decode programs + the prefill buckets the
-    # arrival mix will hit (every bucket in [p_lo, p_hi]); a second
-    # all-sampled wave compiles the sampled decode variant too (the
-    # mix uses both, and a steady-state compile now counts as churn)
+    # warmup: compile both unified-dispatch variants (prompt length no
+    # longer selects a program — the greedy wave compiles one, the
+    # all-sampled wave the other; the mix uses both, and a
+    # steady-state compile now counts as churn)
     warm = [Request(list(range(1, b + 1)), 2, request_id=f"w{b}")
             for b in range(page, max(p_hi + page, page + 1), page)]
     eng.serve(warm)
@@ -1417,10 +1417,10 @@ def bench_gpt2_serving_multitenant():
                 adapter_id=adapters[i % n_adapters]))
         return out
 
-    # warmup: every prefill bucket with an adapter worn, the
-    # greedy-only decode composition, then the sampled one (separate
-    # serves — the decode program specializes on the batch's sampling
-    # mix) — after this, adapter churn must be free
+    # warmup: the unified dispatch with an adapter worn, greedy-only
+    # first, then the sampled variant (separate serves — the program
+    # specializes on the batch's sampling mix) — after this, adapter
+    # churn must be free
     warm = [Request(list(range(1, b + 1)), 2, request_id=f"w{b}",
                     adapter_id=adapters[b % n_adapters])
             for b in range(page, min(p_hi + page, max_len), page)]
@@ -1521,6 +1521,222 @@ def bench_gpt2_serving_multitenant():
           and shed["hog"] > 0 and not shed["aria"] and not shed["bold"]
           and pool.page_ins > pool_slots - 1   # churn actually happened
           and jain >= 0.8)
+    return 0 if ok else 1
+
+
+def bench_gpt2_serving_chunked():
+    """Chunked-prefill serving: a Poisson mix of short prompts and
+    long (2-4k-token on TPU) prompts served through the unified
+    fixed-shape dispatch, run under two chunking configs on IDENTICAL
+    request streams — `monolithic` (chunk_tokens = max_length: a whole
+    prompt lands in one dispatch, the pre-chunking behaviour where
+    every co-resident decoder stalls for the full prefill) and `paged`
+    (chunk_tokens = page_size, the default: long prompts stream one
+    page per tick next to everyone else's decode). Reports tokens/sec,
+    TTFT p50/p99 split short vs long (cross-checked against the
+    serving_ttft_by_prompt_seconds histogram children), decode
+    inter-token p99, and steady_state_compiles per config. Because
+    chunk size is runtime data to the one compiled program, BOTH
+    configs must show zero steady-state compiles across arbitrary
+    unbucketed prompt lengths, and greedy token streams must agree
+    across configs (chunking is a pure scheduling knob; sampled
+    streams may flip a near-boundary draw because the two dispatch
+    widths are different XLA programs with different float rounding).
+    Pass criteria: zero steady compiles, clean page audits, every
+    request finished, greedy outputs identical across configs, and
+    paged short-prompt TTFT p99 no worse than monolithic's +10%.
+    vs_baseline is the monolithic / paged short-TTFT-p99 ratio
+    (>1 = chunking helped)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import GPT2ForCausalLM, gpt2_774m_config
+    from mxnet_tpu.serving import Request, ServingEngine
+
+    from mxnet_tpu import telemetry
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8))
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS",
+                                    32 if on_tpu else 20))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", 0))  # req/s; 0=open
+    cfg = gpt2_774m_config(dtype="bfloat16" if on_tpu else "float32",
+                           dropout=0.0, attention_dropout=0.0)
+    max_len, page = 4096, 64
+    p_lo, p_hi, o_lo, o_hi = 16, 128, 32, 128
+    l_lo, l_hi = 2048, 3584
+    if not on_tpu:  # CPU smoke config: "long" is long vs max_length,
+        # and the model is kept wide enough that a W=128 dispatch
+        # costs visibly more than a W=8 one (a toy net would be
+        # dispatch-overhead-bound and hide the chunking win)
+        cfg.vocab_size, cfg.units, cfg.hidden_size = 512, 256, 1024
+        cfg.num_layers, cfg.num_heads, cfg.max_length = 2, 4, 128
+        max_len, page = 128, 8
+        p_lo, p_hi, o_lo, o_hi = 2, 12, 4, 12
+        l_lo, l_hi = 64, 96
+        slots = min(slots, 4)
+
+    net = GPT2ForCausalLM(cfg)
+    net.initialize(mx.init.Normal(0.02))
+    if on_tpu:
+        net.cast("bfloat16")
+
+    def mk_requests(n, id0):
+        # reseeded per config -> both configs serve the SAME stream;
+        # the first two prompts are long so the long-prefill stream is
+        # in flight while every short request's TTFT clock runs
+        rng = np.random.default_rng(11)
+        out = []
+        for i in range(n):
+            is_long = i < 2 or rng.random() < 0.25
+            lo, hi = (l_lo, l_hi) if is_long else (p_lo, p_hi)
+            out.append(Request(
+                rng.integers(0, cfg.vocab_size,
+                             int(rng.integers(lo, hi + 1))).tolist(),
+                int(rng.integers(o_lo, o_hi + 1)),
+                do_sample=bool(i % 2), temperature=0.8, top_k=40,
+                seed=i, request_id=id0 + i))
+        return out
+
+    def ttft_hist_children(eid):
+        # the per-prompt-length TTFT histogram, split by power-of-two
+        # prompt bucket — the in-process cross-check for the
+        # request-derived numbers below
+        fam = telemetry.get("serving_ttft_by_prompt_seconds")
+        out = {}
+        for vals, child in fam._samples():
+            if vals and vals[0] == str(eid) and child.count:
+                out[vals[1]] = {
+                    "count": child.count,
+                    "p50_ms": round(child.percentile(50) * 1e3, 2),
+                    "p99_ms": round(child.percentile(99) * 1e3, 2)}
+        return out
+
+    def run_config(tag, chunk_tokens):
+        eng = ServingEngine(net, num_slots=slots, max_length=max_len,
+                            page_size=page, chunk_tokens=chunk_tokens)
+        # warmup compiles BOTH unified variants — prompt length no
+        # longer selects a program, so one short greedy serve plus one
+        # short sampled serve cover every length the stream will throw
+        # at it (served separately: a mixed batch only exercises the
+        # sampled variant)
+        eng.serve([Request(list(range(1, page + 1)), 2,
+                           request_id=f"{tag}-warm-greedy")])
+        eng.serve([Request(list(range(1, page + 1)), 2, do_sample=True,
+                           seed=0, request_id=f"{tag}-warm-sampled")])
+        eng.mark_warm()
+        c0 = _engine_compiles(eng._eid)
+        eng.reset_stats()
+
+        reqs = mk_requests(n_requests, id0=1000)
+        rng = np.random.default_rng(13)
+        gaps = rng.exponential(1.0 / rate, n_requests) if rate > 0 \
+            else np.zeros(n_requests)
+        arrivals = np.cumsum(gaps)
+        t0 = time.perf_counter()
+        pending = list(zip(arrivals, reqs))
+        while pending or eng.has_work:
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                eng.submit(pending.pop(0)[1])
+            if eng.has_work:
+                eng.step()
+            elif pending:
+                time.sleep(min(pending[0][0] - now, 0.01))
+        dt = time.perf_counter() - t0
+
+        fin = [r for r in reqs if r.status == "finished"]
+        tokens = sum(len(r.output_tokens) for r in fin)
+
+        def ttft_split(pred):
+            w = [(r.token_times[0] - r.t_submit) * 1e3 for r in reqs
+                 if pred(len(r.prompt)) and r.token_times]
+            if not w:
+                return None
+            return {"count": len(w),
+                    "p50_ms": round(float(np.percentile(w, 50)), 2),
+                    "p99_ms": round(float(np.percentile(w, 99)), 2)}
+
+        tl = telemetry.get("serving_token_latency_seconds").labels(
+            eng._eid)
+        s = eng.stats
+        return {
+            "chunk_tokens": chunk_tokens,
+            "dispatch_width": eng._width,
+            "tokens_per_sec": round(tokens / dt, 2),
+            "ttft_short_ms": ttft_split(lambda p: p <= p_hi),
+            "ttft_long_ms": ttft_split(lambda p: p >= l_lo),
+            "ttft_by_prompt_bucket": ttft_hist_children(eng._eid),
+            "decode_p99_ms": round(tl.percentile(99) * 1e3, 2)
+            if tl.count else None,
+            "steady_state_compiles": _engine_compiles(eng._eid) - c0,
+            "prefill_chunks": s["prefill_chunks"],
+            "decode_dispatches": s["decode_dispatches"],
+            "finished": len(fin), "requests": n_requests,
+            "makespan_s": round(dt, 3),
+            "audit_leaks": len(eng.audit_pages()),
+            "outputs": {r.id: (bool(r.do_sample), list(r.output_tokens))
+                        for r in reqs},
+            "device_cost": _device_cost_extras(eng._eid),
+        }
+
+    mono = run_config("monolithic", max_len)
+    paged = run_config("paged", page)
+    # the two configs compile DIFFERENT dispatch widths (W=max_len vs
+    # W=page), i.e. different XLA programs whose float reductions may
+    # round differently — greedy argmax streams must still agree
+    # (chunking is a pure scheduling knob), while sampled streams may
+    # legitimately flip a near-boundary draw; both are reported
+    out_m, out_p = mono.pop("outputs"), paged.pop("outputs")
+    identical = out_m == out_p
+    greedy_identical = \
+        {k: v for k, v in out_m.items() if not v[0]} \
+        == {k: v for k, v in out_p.items() if not v[0]}
+
+    def p99(block):
+        return block["ttft_short_ms"]["p99_ms"] \
+            if block["ttft_short_ms"] else None
+    ratio = round(p99(mono) / p99(paged), 3) \
+        if p99(mono) and p99(paged) else 0.0
+
+    n_long = sum(1 for r in mk_requests(n_requests, 0)
+                 if len(r.prompt) >= l_lo)
+    _emit("gpt2_serving_chunked_tokens_per_sec",
+          paged["tokens_per_sec"], "tokens/sec", ratio, extras={
+              "short_ttft_p99_speedup_vs_monolithic": ratio,
+              "identical_outputs_across_chunk_sizes": identical,
+              "greedy_outputs_identical_across_chunk_sizes":
+                  greedy_identical,
+              "paged": paged, "monolithic": mono,
+              "short_prompts": n_requests - n_long,
+              "long_prompts": n_long, "slots": slots,
+              "prompt_lens": f"short U[{p_lo},{p_hi}] + "
+                             f"long U[{l_lo},{l_hi}]",
+              "output_lens": f"U[{o_lo},{o_hi}]",
+              "arrivals": "open-loop" if rate == 0
+                          else f"poisson({rate}/s)",
+              "params": cfg.num_params(),
+              "device": str(dev.device_kind),
+              "baseline": "monolithic chunk_tokens=max_length (the "
+                          "pre-chunking whole-prompt dispatch) on the "
+                          "same stream",
+          })
+    # the gate lane tracks short-prompt TTFT directly (lower-better by
+    # name) so a chunk-scheduling regression fails bench_compare even
+    # when aggregate tokens/sec holds
+    _emit("gpt2_serving_chunked_short_ttft_p99_ms", p99(paged) or 0.0,
+          "ms", ratio, extras={
+              "monolithic_p99_ms": p99(mono),
+              "long_stream_in_flight": True,
+          })
+    ok = (paged["steady_state_compiles"] == 0
+          and mono["steady_state_compiles"] == 0
+          and not paged["audit_leaks"] and not mono["audit_leaks"]
+          and paged["finished"] == n_requests
+          and mono["finished"] == n_requests
+          and greedy_identical
+          and (not p99(mono) or not p99(paged)
+               or p99(paged) <= 1.10 * p99(mono)))
     return 0 if ok else 1
 
 
@@ -1681,6 +1897,9 @@ def main():
     if workload in ("serving_multitenant", "multitenant", "lora",
                     "gpt2_serving_multitenant"):
         return bench_gpt2_serving_multitenant()
+    if workload in ("serving_chunked", "chunked", "chunked_prefill",
+                    "gpt2_serving_chunked"):
+        return bench_gpt2_serving_chunked()
     if workload == "decode":
         return bench_decode()
     if workload in ("longcontext", "long"):

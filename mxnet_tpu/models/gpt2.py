@@ -178,12 +178,16 @@ class GPT2Attention(HybridBlock):
             # ragged serving decode: each slot appends at its OWN length
             # and attends only its live pages through the ragged paged-
             # attention kernel — no dense (B, T_max) gather at all.
-            # t == 1 is plain decode; t > 1 is a speculative-
-            # verification dispatch (current token + drafts), where
-            # query position j attends < length + j + 1 through the
-            # multi-query kernel's per-position causal offsets.
+            # t == 1 is plain decode; t > 1 is a multi-query dispatch
+            # (speculative verify, or the unified chunked-prefill
+            # serving step) where query position j attends
+            # < length + j + 1 through the span kernel's per-position
+            # causal offsets. When the cache carries per-slot `spans`
+            # (the unified fixed-shape dispatch), rows past a slot's
+            # span neither attend nor write — the kernel emits exact
+            # zeros for them.
             from ..ops.pallas_attention import (ragged_decode_attention,
-                                                ragged_mq_decode_attention)
+                                                ragged_span_attention)
             cache = cache.write_decode(layer_idx, k._data, v._data)
             impl = cache.attn_impl
             interp = impl == "pallas_interpret"
@@ -197,11 +201,12 @@ class GPT2Attention(HybridBlock):
                 b, h, d = out.shape
                 out = out.astype(q._data.dtype).reshape(b, 1, h * d)
             else:
-                out = ragged_mq_decode_attention(
+                out = ragged_span_attention(
                     q._data.transpose(0, 2, 1, 3).astype(
                         cache.k_pages.dtype),
                     cache.k_pages[layer_idx], cache.v_pages[layer_idx],
                     cache.page_table, cache.length + 1,
+                    q_counts=getattr(cache, "spans", None),
                     impl=impl, interpret=interp)
                 b, tq, h, d = out.shape
                 out = out.astype(q._data.dtype).reshape(b, tq, h * d)

@@ -105,7 +105,7 @@ class PagedKVCache:
     or a (B,) vector (ragged serving decode, one live length per slot)."""
 
     def __init__(self, k_pages, v_pages, page_table, length,
-                 page_lock=None, attn_impl="auto"):
+                 page_lock=None, spans=None, attn_impl="auto"):
         self.k_pages = k_pages
         self.v_pages = v_pages
         self.page_table = page_table
@@ -116,6 +116,13 @@ class PagedKVCache:
         # the actual copy-on-write split, this mask is the in-program
         # guarantee that a stray write drops instead of corrupting)
         self.page_lock = page_lock
+        # optional (B,) int32: live query tokens per slot for the
+        # CURRENT dispatch (decode=1, verify=S, prefill chunk=C,
+        # idle=0). Rows past a slot's span neither write KV
+        # (write_decode drops them) nor attend (the span attention
+        # kernel masks them to exact zeros) — the unified fixed-shape
+        # serving dispatch rides on this
+        self.spans = spans
         self.attn_impl = attn_impl
 
     @classmethod
@@ -187,7 +194,7 @@ class PagedKVCache:
         vp = self.v_pages.at[layer, pages, slot].set(
             v_t.astype(self.v_pages.dtype))
         new = PagedKVCache(kp, vp, self.page_table, self.length,
-                           page_lock=self.page_lock,
+                           page_lock=self.page_lock, spans=self.spans,
                            attn_impl=self.attn_impl)
         return new._gather(kp, layer), new._gather(vp, layer), new
 
@@ -220,6 +227,12 @@ class PagedKVCache:
         num_pages = self.k_pages.shape[1]
         # positions past capacity get an out-of-range pool page → drop
         pages = jnp.where(page_idx < P, safe, num_pages)
+        if self.spans is not None:
+            # unified fixed-shape dispatch: slot b only has spans[b] live
+            # query rows this step (decode=1, verify=S, chunk=C, idle=0);
+            # dead rows carry garbage activations and must not land
+            live = jnp.arange(t)[None, :] < self.spans[:, None]
+            pages = jnp.where(live, pages, num_pages)
         if self.page_lock is not None:
             locked = jnp.take(self.page_lock,
                               jnp.minimum(pages, num_pages - 1)) \
@@ -232,45 +245,32 @@ class PagedKVCache:
         vp = self.v_pages.at[layer, pages, slot].set(
             v_t.astype(self.v_pages.dtype), mode="drop")
         return PagedKVCache(kp, vp, self.page_table, self.length,
-                            page_lock=self.page_lock,
+                            page_lock=self.page_lock, spans=self.spans,
                             attn_impl=self.attn_impl)
 
     def write_prompt(self, layer, k, v):
         """Prefill write of a whole (B, H, T, D) chunk starting at
-        position `length`, which must be PAGE-ALIGNED (length %
-        page_size == 0) — the serving engine's suffix prefill lands a
-        prompt's uncached tail right after its prefix-cache pages this
-        way. length==0 (the classic whole-prompt prefill) is the
-        aligned special case. T is padded up to whole pages; lockstep
-        (scalar-length) caches only."""
+        position `length`. Folded onto the write_decode positional
+        scatter (token j of slot b lands at length + j through the page
+        table), so any offset works — page-aligned starts (the classic
+        whole-prompt prefill at length==0, or a suffix landing right
+        after prefix-cache pages) and mid-page chunk cursors alike.
+        Lockstep (scalar-length) caches only; ragged slots prefill
+        through the unified chunked dispatch (serving.ServingEngine),
+        which IS write_decode. Returns gathered (B, H, T_max, D) views
+        + the updated cache, like write()."""
         if self.ragged:
             raise MXNetError("write_prompt needs a lockstep cache "
                              "(scalar length); ragged slots prefill "
                              "individually (serving.ServingEngine)")
-        B, H, T, D = k.shape
-        S = self.page_size
-        n_pages = (T + S - 1) // S
-        pad = n_pages * S - T
-        kq = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
-        vq = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
-        # (B, H, nP*S, D) → (B, nP, S, H, D) — the pool's page layout
-        kq = kq.transpose(0, 2, 1, 3).reshape(B, n_pages, S, H, D)
-        vq = vq.transpose(0, 2, 1, 3).reshape(B, n_pages, S, H, D)
-        start_page = jnp.asarray(self.length, jnp.int32) // S
-        tbl = lax.dynamic_slice(
-            self.page_table, (jnp.zeros((), jnp.int32), start_page),
-            (B, n_pages))                             # (B, nP) at offset
-        kp = self.k_pages.at[layer, tbl].set(kq.astype(self.k_pages.dtype))
-        vp = self.v_pages.at[layer, tbl].set(vq.astype(self.v_pages.dtype))
-        new = PagedKVCache(kp, vp, self.page_table, self.length,
-                           page_lock=self.page_lock,
-                           attn_impl=self.attn_impl)
-        return new._gather(kp, layer), new._gather(vp, layer), new
+        new = self.write_decode(layer, k, v)
+        return (new._gather(new.k_pages, layer),
+                new._gather(new.v_pages, layer), new)
 
     def advance(self, n):
         return PagedKVCache(self.k_pages, self.v_pages, self.page_table,
                             self.length + n, page_lock=self.page_lock,
-                            attn_impl=self.attn_impl)
+                            spans=self.spans, attn_impl=self.attn_impl)
 
     def key_mask(self, extra=0):
         """Validity over key positions: (T_max,) in lockstep mode,
@@ -282,7 +282,7 @@ class PagedKVCache:
 
     def tree_flatten(self):
         return (self.k_pages, self.v_pages, self.page_table,
-                self.length, self.page_lock), self.attn_impl
+                self.length, self.page_lock, self.spans), self.attn_impl
 
     @classmethod
     def tree_unflatten(cls, aux, children):

@@ -554,26 +554,34 @@ def ragged_decode_attention(q, k_pages, v_pages, page_table, lengths,
 
 
 # ---------------------------------------------------------------------------
-# multi-query ragged paged-attention (speculative-decoding verification).
+# span (per-slot query count) ragged paged-attention — ONE kernel for
+# prefill chunks, plain decode, and speculative verification.
 #
-# The speculative-decoding dispatch feeds S tokens per slot — the current
-# token plus up to S-1 prompt-lookup drafts — and verifies them all in ONE
-# forward pass (docs/SERVING.md "Speculative decoding"). Query position j
-# of slot b sits at absolute position lengths[b]-1+j, so it may attend key
-# positions < lengths[b]+j: the per-position CAUSAL OFFSET. Same grid and
-# DMA-eliding page remap as the single-query kernel above; the (Sq, S)
-# score tile replaces the (1, S) one and the online-softmax accumulators
-# carry one row per query position.
+# Each of the B slots in a (B, Sq, H, D) dispatch consumes q_counts[b]
+# query tokens: a decode slot 1, a speculative verify S, a prefill chunk
+# C, an idle slot 0. Query row j of slot b sits at absolute position
+# lengths[b]-1+j, so it may attend key positions < lengths[b]+j — the
+# per-position CAUSAL OFFSET — and rows >= q_counts[b] are dead: they
+# accumulate nothing and emit exact zeros. The scalar-prefetch grid skips
+# dead rows AND dead pages (a slot's page extent stretches only to
+# lengths[b] + q_counts[b] - 1; an idle slot visits no page at all), so
+# HBM traffic per dispatch scales with the live work, not B*Sq. Same grid
+# and DMA-eliding page remap as the single-query kernel above; the
+# (Sq, S) score tile replaces the (1, S) one and the online-softmax
+# accumulators carry one row per query position.
 # ---------------------------------------------------------------------------
 
-def _ragged_mq_decode_kernel(table_ref, len_ref, q_ref, k_ref, v_ref,
-                             o_ref, m_ref, l_ref, acc_ref, *, scale, S,
-                             Sq, H, D):
+def _ragged_span_kernel(table_ref, len_ref, qc_ref, q_ref, k_ref, v_ref,
+                        o_ref, m_ref, l_ref, acc_ref, *, scale, S,
+                        Sq, H, D):
     b = pl.program_id(0)
     p = pl.program_id(1)
     length = len_ref[b]
-    # the furthest query (row Sq-1) reaches position length + Sq - 2
-    n_live = (length + Sq - 1 + S - 1) // S
+    qn = qc_ref[b]
+    # the furthest live query (row qn-1) reaches position length + qn - 2;
+    # an idle slot (qn == 0) owns no pages at all — the ceil formula alone
+    # would still visit ceil((length-1)/S) of them
+    n_live = jnp.where(qn == 0, 0, (length + qn - 1 + S - 1) // S)
 
     @pl.when(p == 0)
     def _init():
@@ -584,10 +592,11 @@ def _ragged_mq_decode_kernel(table_ref, len_ref, q_ref, k_ref, v_ref,
     @pl.when(p < n_live)
     def _accumulate():
         # rows are query positions, columns token positions in this page;
-        # row j's causal window is pos < length + j
+        # row j's causal window is pos < length + j, and rows past the
+        # slot's span are fully masked (they emit zeros)
         rows = lax.broadcasted_iota(jnp.int32, (Sq, S), 0)
         cols = p * S + lax.broadcasted_iota(jnp.int32, (Sq, S), 1)
-        valid = cols < length + rows
+        valid = (cols < length + rows) & (rows < qn)
         for h in range(H):
             c0, c1 = h * D, (h + 1) * D
             q = q_ref[0, :, c0:c1]                     # (Sq, D)
@@ -643,64 +652,89 @@ def _ragged_mq_reference(q, k_pages, v_pages, page_table, lengths, scale):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-def ragged_mq_decode_attention(q, k_pages, v_pages, page_table, lengths,
-                               scale=None, impl="auto", interpret=False):
-    """Multi-query ragged paged-attention for one speculative dispatch.
+def _ragged_span_reference(q, k_pages, v_pages, page_table, lengths,
+                           q_counts, scale):
+    """Dense XLA fallback/oracle for the span kernel: the multi-query
+    causal-offset math, with query rows >= q_counts[b] dead — they emit
+    exact zeros (the row-mask contract the unified dispatch relies on:
+    garbage rows of a mixed batch can never leak into live output)."""
+    out = _ragged_mq_reference(q, k_pages, v_pages, page_table, lengths,
+                               scale)
+    rows = jnp.arange(q.shape[1])[None, :] < q_counts[:, None]  # (B, Sq)
+    return jnp.where(rows[:, :, None, None], out,
+                     jnp.zeros_like(out))
 
-    q:              (B, Sq, H, D) — Sq query tokens per slot (the current
-                    token plus the drafts), already written to the cache
-                    at positions lengths-1 .. lengths+Sq-2.
+
+def ragged_span_attention(q, k_pages, v_pages, page_table, lengths,
+                          q_counts=None, scale=None, impl="auto",
+                          interpret=False):
+    """Span ragged paged-attention: ONE fixed-shape program for mixed
+    prefill-chunk / decode / speculative-verify / idle work.
+
+    q:              (B, Sq, H, D) — up to Sq query tokens per slot,
+                    already written to the cache at positions
+                    lengths-1 .. lengths+q_counts-2.
     k_pages/v_pages:(num_pages, S, H, D) — ONE layer's page pools.
     page_table:     (B, P) int32 — physical pages per slot.
     lengths:        (B,) int32 — live tokens through query 0 (its own
                     position included); query j attends key positions
                     < lengths[b] + j (the per-position causal offset).
-    impl/interpret: same contract as ragged_decode_attention. Sq=1 is
-    the degenerate case and matches the single-query kernel exactly.
+    q_counts:       (B,) int32 — live query rows per slot (decode=1,
+                    verify=S, prefill chunk=C, idle=0); rows past the
+                    count emit exact zeros. None means every row is
+                    live (the multi-query/verify case).
+    impl/interpret: same contract as ragged_decode_attention. Sq=1 with
+    q_counts=None matches the single-query kernel exactly.
     Returns (B, Sq, H, D) in q's dtype.
     """
     B, Sq, H, D = q.shape
     N, S = k_pages.shape[0], k_pages.shape[1]
     P = page_table.shape[1]
     s = float(scale) if scale is not None else 1.0 / math.sqrt(D)
+    if q_counts is None:
+        q_counts = jnp.full((B,), Sq, jnp.int32)
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu" and not interpret
         impl = "pallas" if (on_tpu and ragged_supported(q[:, 0], k_pages)) \
             else ("pallas" if interpret else "xla")
     if impl == "xla":
-        return _ragged_mq_reference(q, k_pages, v_pages, page_table,
-                                    lengths, s)
+        return _ragged_span_reference(q, k_pages, v_pages, page_table,
+                                      lengths, q_counts, s)
     if impl != "pallas":
         raise ValueError(f"unknown ragged attention impl {impl!r}")
     qp = q.reshape(B, Sq, H * D)
     kp = k_pages.reshape(N, S, H * D)
     vp = v_pages.reshape(N, S, H * D)
     lengths = lengths.astype(jnp.int32)
+    q_counts = q_counts.astype(jnp.int32)
     table = page_table.astype(jnp.int32)
 
-    def page_index(b, p, tbl, lens):
+    def page_index(b, p, tbl, lens, qcs):
         # same DMA-eliding remap as the single-query kernel, with the
-        # live extent stretched to cover the furthest query position
-        last_live = jnp.maximum((lens[b] + Sq - 1 + S - 1) // S - 1, 0)
+        # live extent stretched to cover the slot's furthest live query;
+        # idle slots (q_count 0) pin every step to their first page and
+        # the kernel body skips all of them
+        last_live = jnp.maximum((lens[b] + qcs[b] - 1 + S - 1) // S - 1, 0)
         return (tbl[b, jnp.minimum(p, last_live)], 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, P),
         in_specs=[
-            pl.BlockSpec((1, Sq, H * D), lambda b, p, tbl, lens: (b, 0, 0)),
+            pl.BlockSpec((1, Sq, H * D),
+                         lambda b, p, tbl, lens, qcs: (b, 0, 0)),
             pl.BlockSpec((1, S, H * D), page_index),
             pl.BlockSpec((1, S, H * D), page_index),
         ],
         out_specs=pl.BlockSpec((1, Sq, H * D),
-                               lambda b, p, tbl, lens: (b, 0, 0)),
+                               lambda b, p, tbl, lens, qcs: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((H, Sq, 128), jnp.float32),   # running max
             pltpu.VMEM((H, Sq, 128), jnp.float32),   # running denominator
             pltpu.VMEM((H, Sq, D), jnp.float32),     # running numerator
         ],
     )
-    kernel = functools.partial(_ragged_mq_decode_kernel, scale=s, S=S,
+    kernel = functools.partial(_ragged_span_kernel, scale=s, S=S,
                                Sq=Sq, H=H, D=D)
     out = pl.pallas_call(
         kernel,
@@ -710,8 +744,18 @@ def ragged_mq_decode_attention(q, k_pages, v_pages, page_table, lengths,
         compiler_params=None if interpret else pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024,
             dimension_semantics=("parallel", "arbitrary")),
-    )(table, lengths, qp, kp, vp)
+    )(table, lengths, q_counts, qp, kp, vp)
     return out.reshape(B, Sq, H, D)
+
+
+def ragged_mq_decode_attention(q, k_pages, v_pages, page_table, lengths,
+                               scale=None, impl="auto", interpret=False):
+    """Multi-query ragged paged-attention (every query row live): the
+    q_counts=None span kernel. Kept as the verify-path entry point; see
+    ragged_span_attention for the full contract."""
+    return ragged_span_attention(q, k_pages, v_pages, page_table,
+                                 lengths, q_counts=None, scale=scale,
+                                 impl=impl, interpret=interpret)
 
 
 def supported(q, k, mask, layout="BHTD"):

@@ -2,12 +2,15 @@
 
 The serving-side counterpart of parallel.TrainStep: where training
 compiles the whole optimizer step into one XLA program, serving compiles
-prefill (per prompt bucket) and a K-step decode block (lax.scan) into
-cached programs and keeps the host out of the token loop. Requests are
-admitted into fixed decode slots between compiled dispatches; each slot
-decodes against its own live length through the ragged paged-attention
-kernel (ops/pallas_attention.ragged_decode_attention), so finished
-sequences stop costing HBM the moment their slot is freed.
+ONE fixed-shape unified dispatch — prompt chunks, single-token decode,
+and speculative verify are all rows of the same (B, W) program — and
+keeps the host out of the token loop. Requests are admitted into fixed
+slots between compiled dispatches; each slot consumes its own query
+span against its own live length through the ragged span-attention
+kernel (ops/pallas_attention.ragged_span_attention), so finished
+sequences stop costing HBM the moment their slot is freed and a
+4k-token prompt streams page-sized chunks next to everyone else's
+decode instead of monopolizing a dispatch.
 
 Page ownership is explicit: serving/page_pool.py is a host-side
 ref-counted allocator over the PagedKVCache page axis, and

@@ -16,22 +16,28 @@ Execution model (docs/SERVING.md):
     kernel is unchanged); the in-program page_lock mask plus a host
     copy-on-write split for fully-cached prompts guarantee no write
     ever lands in a shared page.
-  * PREFILL is one compiled program per SUFFIX-length bucket: it writes
-    the suffix's KV into the slot's pages at the prefix offset
-    (attention reads the cached prefix through the same table) and
-    samples the request's first token.
-  * DECODE runs K steps per host dispatch via lax.scan — the
-    TrainStep.run_steps pattern applied to serving. PERF_NOTES measured
-    ~24 ms/step of host dispatch tax over a remote tunnel; at one
-    token per step that tax would dominate decode, so the block size K
-    amortizes it K-fold.
-  * SPECULATIVE mode (speculative=True) replaces the K-step scan with
-    ONE multi-query forward per dispatch: a host-side prompt-lookup
-    drafter (serving/speculative.py) proposes up to spec_tokens-1
-    candidates from each request's own history, the multi-query ragged
-    kernel verifies all of them under per-position causal offsets, and
-    only the accepted count advances the slot's length — greedy output
-    bit-identical to spec-off, sampled output distribution-preserving.
+  * EVERY dispatch is ONE fixed-shape unified program of width W =
+    max(chunk_tokens, spec_tokens, 2): each slot consumes q_counts[b]
+    of its W query positions — a PREFILL CHUNK (C tokens of the prompt
+    streamed through the span kernel's per-slot query counts), a
+    DECODE step (1), a SPECULATIVE VERIFY (1 + drafts), or idle (0).
+    Admission never runs a forward: it maps pages, parks the prompt as
+    a host-side chunk queue, and the regular dispatch loop feeds
+    chunk_tokens of it per tick next to everyone else's decode — so a
+    4k-token prompt never monopolizes a dispatch, and prompt length is
+    DATA, not a program shape axis (zero prefill retraces, ever).
+  * The final chunk of a prompt samples the request's first token in
+    the same dispatch; prefill_chunk_budget caps the prompt tokens fed
+    per dispatch across all slots (round-robin), bounding every other
+    slot's inter-token latency to one dispatch period.
+  * SPECULATIVE mode (speculative=True) rides the same program: a
+    host-side prompt-lookup drafter (serving/speculative.py) proposes
+    up to spec_tokens-1 candidates from each request's own history,
+    the span kernel verifies all of them under per-position causal
+    offsets, and only the accepted count advances the slot's length —
+    greedy output bit-identical to spec-off, sampled output
+    distribution-preserving. A degraded engine keeps dispatching the
+    same program with zero drafts (bit-identical to plain decode).
   * Per-slot scalar state (lengths, budgets, sampling knobs, tables,
     page_lock) is DEVICE-RESIDENT between dispatches; admission/finish/
     cancel upload one slot's delta in one jitted scatter (_sync_slot),
@@ -41,10 +47,11 @@ Execution model (docs/SERVING.md):
     (FIFO) — continuous batching: nobody waits for the slowest
     sequence in a fixed batch.
 
-Everything per-request (sampling knobs, seeds, eos, budgets) is a
-per-slot ARRAY in the compiled program, so admission never recompiles;
-the only shape-churn axis is the prefill bucket, and those programs live
-in a bounded LRU (gluon.block.LRUTraceCache).
+Everything per-request (sampling knobs, seeds, eos, budgets, chunk
+cursors) is a per-slot ARRAY in the compiled program, so admission
+never recompiles: the engine owns at most two programs (greedy-only
+and mixed-sampling flavors of the one unified dispatch) for its whole
+lifetime — there is no prefill program family and no bucket axis.
 
 ROBUSTNESS (docs/SERVING.md "Robustness"): step() is supervised — a
 dispatch exception no longer wedges the engine. The supervisor catches
@@ -73,13 +80,12 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 from .. import telemetry
 from ..telemetry import cost as _cost
 from ..telemetry import ledger as _ledger
 from ..base import MXNetError
-from ..gluon.block import LRUTraceCache, _trace_channel
+from ..gluon.block import _trace_channel
 from ..models.kv_cache import PagedKVCache
 from ..ndarray.ndarray import NDArray
 from ..telemetry import server as _tserver
@@ -108,15 +114,27 @@ def _engine_metrics(eid):
     c, g, h = telemetry.counter, telemetry.gauge, telemetry.histogram
     m = {
         "prefills": c("serving_prefill_total",
-                      "prefill dispatches (one per admitted request)", _E),
+                      "prompts fully prefilled — final chunk landed and "
+                      "the first token sampled (one per admission)", _E),
         "prefill_tokens": c(
             "serving_prefill_tokens_total",
-            "prompt tokens actually computed by prefill (the uncached "
-            "suffix only when the prefix cache hits)", _E),
+            "prompt tokens actually computed by prefill chunks (the "
+            "uncached suffix only when the prefix cache hits)", _E),
+        "prefill_chunks": c(
+            "serving_prefill_chunks_total",
+            "prompt chunks fed through the unified dispatch (a prompt "
+            "of T uncached tokens streams in ceil(T / chunk_tokens) "
+            "chunks, budget permitting)", _E),
+        "prefill_pending": g(
+            "serving_prefill_pending_tokens",
+            "chunk-queue depth: admitted prompt tokens not yet fed to "
+            "a dispatch, summed over slots", _E),
         "decode_dispatches": c("serving_decode_dispatch_total",
-                               "compiled K-step decode blocks run", _E),
+                               "unified dispatches run (one fixed-shape "
+                               "program per tick)", _E),
         "decode_steps": c("serving_decode_steps_total",
-                          "decode steps run (dispatches x K)", _E),
+                          "decode steps run (== dispatches: one "
+                          "forward per tick)", _E),
         "tokens_emitted": c("serving_tokens_emitted_total",
                             "tokens sampled and handed to requests", _E),
         "requests_finished": c("serving_requests_finished_total",
@@ -185,12 +203,13 @@ def _engine_metrics(eid):
                   "submit -> first token (queue wait + prefill)", _E),
         "token_latency": h(
             "serving_token_latency_seconds",
-            "per-token decode latency at decode-block resolution "
-            "(dispatch wall / K, weighted by tokens emitted)", _E),
+            "per-token decode latency at dispatch resolution "
+            "(dispatch wall / tokens the slot emitted, weighted)", _E),
         "prefill_seconds": h("serving_prefill_seconds",
-                             "prefill dispatch wall time", _E),
+                             "wall time of unified dispatches that "
+                             "carried at least one prefill chunk", _E),
         "decode_seconds": h("serving_decode_dispatch_seconds",
-                            "K-step decode block wall time", _E),
+                            "unified dispatch wall time", _E),
         "drain_seconds": h("serving_drain_seconds",
                            "serve(): last submit -> queue+slots empty", _E),
         "dispatch_errors": c(
@@ -238,7 +257,20 @@ def _engine_metrics(eid):
     }
     _shed_family()                  # registered per-process; children
     _tenant_families()
+    _ttft_family()
     return {k: inst.labels(eid) for k, inst in m.items()}
+
+
+def _ttft_family():
+    """TTFT split by power-of-two prompt-length bucket: the chunked-
+    prefill TTFT model (docs/SERVING.md) predicts TTFT grows with
+    ceil(prompt / chunk_tokens) dispatch periods — this histogram is
+    how that claim is checked in production."""
+    return telemetry.histogram(
+        "serving_ttft_by_prompt_seconds",
+        "submit -> first token, split by power-of-two prompt-length "
+        "bucket (label prompt_bucket=le<N>)",
+        ("engine", "prompt_bucket"))
 
 
 def _shed_family():
@@ -284,12 +316,20 @@ class ServingEngine:
     num_slots: concurrent decode sequences (the compiled batch).
     max_length: per-slot KV capacity (prompt + generated), rounded down
         to a whole number of pages; defaults to the model's max_length.
-    page_size: KV page granularity. decode_block: decode steps fused
-    into one dispatch. attn_impl: 'auto' (ragged Pallas kernel on TPU,
-    dense XLA elsewhere), 'pallas', 'pallas_interpret' (the kernel in
-    interpret mode — CPU tests), or 'xla'. max_queue bounds the
-    admission queue (None = unbounded); a full queue rejects submit()
-    with QueueFullError and counts serving_requests_rejected_total.
+    page_size: KV page granularity. chunk_tokens: prompt tokens one
+    slot feeds per dispatch while prefilling (default page_size) — the
+    dispatch width is W = max(chunk_tokens, spec_tokens, 2), fixed for
+    the engine's lifetime. prefill_chunk_budget: prompt tokens per
+    dispatch across ALL slots (default chunk_tokens), round-robined so
+    concurrent long prompts share the prefill lane fairly while decode
+    rows ride every dispatch untouched. decode_block / prefill_bucket
+    are accepted for compatibility and ignored — there is no K-step
+    scan and no bucket axis anymore. attn_impl: 'auto' (ragged Pallas
+    kernel on TPU, dense XLA elsewhere), 'pallas', 'pallas_interpret'
+    (the kernel in interpret mode — CPU tests), or 'xla'. max_queue
+    bounds the admission queue (None = unbounded); a full queue rejects
+    submit() with QueueFullError and counts
+    serving_requests_rejected_total.
 
     prefix_cache=True turns on radix-tree prompt reuse: admission
     longest-prefix-matches each prompt against previously served ones
@@ -300,13 +340,12 @@ class ServingEngine:
     bit-identical with the cache on or off.
 
     speculative=True turns on prompt-lookup speculative decoding
-    (serving/speculative.py, docs/SERVING.md): each decode dispatch
-    feeds spec_tokens positions per slot — the current token plus up to
-    spec_tokens-1 n-gram drafts from the request's own history — and
-    ONE multi-query verification forward emits every accepted token.
+    (serving/speculative.py, docs/SERVING.md): each dispatch feeds up
+    to spec_tokens positions per decoding slot — the current token
+    plus up to spec_tokens-1 n-gram drafts from the request's own
+    history — and the same unified forward verifies all of them.
     Greedy output is bit-identical to speculative=False; sampled output
     is distribution-preserving and reproducible across schedules.
-    decode_block is ignored in this mode (a dispatch is one forward).
     spec_max_ngram/spec_min_ngram bound the lookup n-gram sizes.
 
     Every engine reports into mx.telemetry as per-engine labeled
@@ -317,7 +356,8 @@ class ServingEngine:
     """
 
     def __init__(self, model, num_slots, max_length=None, page_size=64,
-                 decode_block=8, attn_impl="auto", prefill_bucket=None,
+                 decode_block=None, attn_impl="auto", prefill_bucket=None,
+                 chunk_tokens=None, prefill_chunk_budget=None,
                  dtype=None, max_queue=None, prefix_cache=False,
                  prefix_cache_pages=None, speculative=False,
                  spec_tokens=4, spec_max_ngram=3, spec_min_ngram=1,
@@ -337,11 +377,19 @@ class ServingEngine:
                              f"model's position range {cfg.max_length}")
         self.max_length = max_length
         self.page_size = int(page_size)
-        self.decode_block = int(decode_block)
-        if self.decode_block < 1:
-            raise MXNetError("decode_block must be >= 1")
+        # legacy knobs of the bucketed/K-step engine: accepted so old
+        # configs keep constructing, but the unified dispatch has no
+        # bucket axis and no step fusion for them to tune
+        self.decode_block = decode_block
+        self.prefill_bucket = prefill_bucket
         self.attn_impl = attn_impl
-        self.prefill_bucket = int(prefill_bucket or page_size)
+        self.chunk_tokens = int(chunk_tokens or page_size)
+        if self.chunk_tokens < 1:
+            raise MXNetError("chunk_tokens must be >= 1")
+        self.prefill_chunk_budget = int(
+            prefill_chunk_budget or self.chunk_tokens)
+        if self.prefill_chunk_budget < 1:
+            raise MXNetError("prefill_chunk_budget must be >= 1")
         self.speculative = bool(speculative)
         self.spec_tokens = int(spec_tokens)
         if self.speculative:
@@ -355,6 +403,11 @@ class ServingEngine:
             # drafter matches against — the request's OWN history only,
             # so drafting is schedule-independent
             self._hist = [None] * int(num_slots)
+        # ONE dispatch width forever: wide enough for a prefill chunk,
+        # a speculative verify window, or a decode step (>= 2 keeps
+        # every dispatch on the span kernel's multi-query path)
+        self._width = max(self.chunk_tokens,
+                          self.spec_tokens if self.speculative else 0, 2)
         self.scheduler = SlotScheduler(num_slots, max_queue=max_queue,
                                        num_priorities=num_priorities,
                                        tenant_quotas=tenant_quotas)
@@ -427,14 +480,20 @@ class ServingEngine:
         self._aslot = np.zeros(B, np.int32)
         self._adapter_of = [None] * B   # slot -> pinned adapter_id
 
-        self._prefill_programs = LRUTraceCache(
-            max(2 * (max_length // self.prefill_bucket), 8))
-        # decode programs come in two flavors selected PER DISPATCH: the
-        # general mixed-sampling one and a greedy-only one that skips
-        # the filtered-distribution sort and the RNG draws entirely
-        # (greedy batches dominate production serving; greedy rows are
-        # bit-identical through either program)
-        self._decode_programs = {}
+        # per-slot chunk queues: the not-yet-fed tail of each admitted
+        # prompt (np.int32; None = slot has no prefill work). The
+        # dispatch loop drains them chunk_tokens at a time under the
+        # prefill_chunk_budget, starting at a rotating slot cursor.
+        self._pending = [None] * B
+        self._base = np.zeros(B, np.int32)   # resume offset per slot
+        self._chunk_rr = 0
+        # the unified program comes in two flavors selected PER
+        # DISPATCH: the general mixed-sampling one and a greedy-only
+        # one that skips the filtered-distribution sort and the RNG
+        # draws entirely (greedy batches dominate production serving;
+        # greedy rows are bit-identical through either program). These
+        # two keys are the engine's ENTIRE program registry.
+        self._programs = {}
 
         def _copy_page(kp, vp, src, dst):
             # CoW split: clone one physical page's (L, S, H, D) slab
@@ -463,6 +522,8 @@ class ServingEngine:
         self._shed = _shed_family()
         self._shed_children = {}   # (reason, priority) -> labeled child
         self._shed_counts = {}     # same keys, host-side for stats
+        self._ttft_fam = _ttft_family()
+        self._ttft_children = {}   # prompt bucket -> labeled child
         self._tenant_fams = _tenant_families()
         self._tenant_children = {}   # (family, tenant[, reason]) -> child
         self._tenant_shed_counts = {}  # (tenant, reason) -> n
@@ -513,6 +574,8 @@ class ServingEngine:
         return {
             "prefills": int(m["prefills"].value),
             "prefill_tokens": int(m["prefill_tokens"].value),
+            "prefill_chunks": int(m["prefill_chunks"].value),
+            "prefill_pending": int(m["prefill_pending"].value),
             "decode_dispatches": int(m["decode_dispatches"].value),
             "decode_steps": int(m["decode_steps"].value),
             "tokens_emitted": int(m["tokens_emitted"].value),
@@ -568,6 +631,8 @@ class ServingEngine:
         for child in self._tenant_children.values():
             child.reset()
         self._tenant_shed_counts = {}
+        for child in self._ttft_children.values():
+            child.reset()
         self._adapter_page_ins_seen = 0
         self._adapter_evictions_seen = 0
         self._metrics["num_slots"].set(self.num_slots)
@@ -598,6 +663,19 @@ class ServingEngine:
             self._tenant_children[key] = child
         self._tenants_seen.add(tenant)
         return child
+
+    def _observe_ttft(self, prompt_len, dt):
+        """The labeled TTFT-vs-prompt-length child (power-of-two
+        buckets; children created lazily as lengths appear)."""
+        b = 1
+        while b < prompt_len:
+            b <<= 1
+        key = f"le{b}"
+        child = self._ttft_children.get(key)
+        if child is None:
+            child = self._ttft_fam.labels(self._eid, key)
+            self._ttft_children[key] = child
+        child.observe(dt)
 
     def _set_load_gauges(self):
         self._metrics["queue_depth"].set(self.scheduler.num_queued)
@@ -680,9 +758,10 @@ class ServingEngine:
                 "num_slots": self.num_slots,
                 "max_length": self.max_length,
                 "page_size": self.page_size,
-                "decode_block": self.decode_block,
+                "chunk_tokens": self.chunk_tokens,
+                "prefill_chunk_budget": self.prefill_chunk_budget,
+                "dispatch_width": self._width,
                 "attn_impl": self.attn_impl,
-                "prefill_bucket": self.prefill_bucket,
                 "prefix_cache": self.prefix_cache is not None,
                 "speculative": self.speculative,
                 "spec_tokens": self.spec_tokens
@@ -1083,7 +1162,9 @@ class ServingEngine:
     def step(self):
         """One SUPERVISED scheduling round: shed queued work past its
         deadline, cancel running work past its deadline, admit free
-        slots (prefill), run one decode dispatch, free finished slots.
+        slots (queue their prompt chunks), run ONE unified dispatch
+        (prefill chunks + decode + verify in the same fixed-shape
+        program), free finished slots.
 
         Dispatch exceptions do NOT propagate. The supervisor catches
         them, runs the page-pool invariant audit, latches a
@@ -1106,8 +1187,6 @@ class ServingEngine:
             req = self.scheduler.request_at(slot)
             if req.t_deadline is not None and now >= req.t_deadline:
                 finished.append(self._deadline_cancel(slot))
-        if self.policy is not None:
-            self.policy.on_step(self, now)
         for slot, req in self.scheduler.admit(now):
             try:
                 fin = self._admit(slot, req)
@@ -1118,10 +1197,15 @@ class ServingEngine:
                 continue
             if fin is not None:
                 finished.append(fin)
+        if self.policy is not None:
+            # Assess AFTER admission: the overload level must reflect the
+            # backlog this tick's dispatch actually leaves queued, not the
+            # pre-admission spike that free slots are about to absorb.
+            self.policy.on_step(self, now)
         self._set_load_gauges()
         if self.scheduler.num_active:
             try:
-                finished.extend(self._decode_block())
+                finished.extend(self._dispatch())
             except Exception as e:          # noqa: BLE001 — supervisor
                 finished.extend(self._on_decode_fault(e))
             self._set_load_gauges()
@@ -1339,6 +1423,7 @@ class ServingEngine:
         self.scheduler.release(slot)
         self._free_slot_pages(slot)
         self._release_adapter(slot)
+        self._pending[slot] = None
         self._done[slot] = True
         self._remaining[slot] = 0
         self._lengths[slot] = self.max_length
@@ -1530,67 +1615,18 @@ class ServingEngine:
             self.page_pool.free(self.page_pool.decref(row))
         self._mapped[slot] = False
 
-    # -- prefill -----------------------------------------------------------
-    def _bucket(self, n, offset=0):
-        if n == 1:
-            return 1     # CoW / one-token suffixes get their own program
-        b = self.prefill_bucket
-        return min(((n + b - 1) // b) * b, self.max_length - offset)
-
-    def _build_prefill(self, t_bucket):
-        model, params = self.model, self._params
-
-        def prefill(param_arrays, kp, vp, ids, row, offset, true_len,
-                    counter0, seed, temp, top_k, top_p, do_sample, eos,
-                    *adapter):
-            # `adapter` is () (pool disabled: the trace is byte-identical
-            # to the pre-adapter program) or (aslot, A, B, scale): the
-            # slot's slab index is traced DATA — any adapter mix reuses
-            # this one program
-            saved = [p._data for p in params]
-            _trace_channel.push_frame()
-            prev_ctx = None
-            if adapter:
-                aslot, a_A, a_B, a_scale = adapter
-                prev_ctx = _set_adapter_ctx(
-                    (a_A, a_B, a_scale, aslot[None]))
-            try:
-                for p, d in zip(params, param_arrays):
-                    arr = NDArray(d)
-                    arr._grad_req = "null"
-                    p._data = arr
-                # the slot's FULL table row: attention reads the cached
-                # prefix pages and the freshly written suffix through
-                # one gather; length=offset puts the suffix writes (and
-                # positions) right after the prefix
-                cache = PagedKVCache(kp, vp, row[None, :], offset,
-                                     attn_impl=self.attn_impl)
-                logits, cache = model.forward(NDArray(ids), cache)
-            finally:
-                if adapter:
-                    _set_adapter_ctx(prev_ctx)
-                _trace_channel.pop_frame()
-                for p, d in zip(params, saved):
-                    p._data = d
-            last = jnp.take(logits._data[0], true_len - 1, axis=0)
-            # the RNG stream is keyed (seed, token_index): counter0 is
-            # the index of the token this prefill samples — 0 for a
-            # fresh admission, len(output_tokens) for a rolled-back
-            # request restarting mid-generation (bit-identical resume)
-            key = slot_keys(seed[None], counter0[None])
-            first = sample_tokens(last[None], key, do_sample[None],
-                                  temp[None], top_k[None], top_p[None])[0]
-            done0 = (first == eos) & (eos >= 0)
-            return cache.k_pages, cache.v_pages, first, done0
-
-        return jax.jit(prefill, donate_argnums=(1, 2))
-
+    # -- admission ---------------------------------------------------------
     def _admit(self, slot, req):
-        # restart continuation: a request rolled back after a caught
-        # fault already emitted `base` tokens — re-prefill the prompt
-        # PLUS those tokens and resume the RNG stream at token index
-        # `base`, making the recovered output bit-identical to an
-        # uninterrupted run (streams are keyed (seed, token_index))
+        """Map pages and park the prompt as this slot's chunk queue —
+        NO forward runs here. The unified dispatch streams the queue
+        chunk_tokens at a time next to everyone else's decode work and
+        samples the first token when the final chunk lands.
+
+        Restart continuation: a request rolled back after a caught
+        fault already emitted `base` tokens — re-feed the prompt PLUS
+        those tokens and resume the RNG stream at token index `base`,
+        making the recovered output bit-identical to an uninterrupted
+        run (streams are keyed (seed, token_index))."""
         base = len(req.output_tokens)
         tokens = req.prompt if not base else np.concatenate(
             [req.prompt, np.asarray(req.output_tokens, np.int32)])
@@ -1610,84 +1646,40 @@ class ServingEngine:
             self._adapter_of[slot] = req.adapter_id \
                 if req.adapter_id not in (None, 0) else None
             self._aslot[slot] = aslot
+        # a prefix-cache hit seeds the chunk cursor past the shared
+        # pages: length starts at the cached offset and the queue holds
+        # only the uncached tail (>= 1 token — a fully cached prompt is
+        # re-homed by the CoW split to recompute its last position)
         offset = self._map_slot_pages(slot, tokens)
-        req.status = "running"
+        req.status = "prefilling"
         if req.tenant is not None:
             self._tenant_child("admitted", req.tenant).inc()
-        if self.prefix_cache is not None:
-            telemetry.request_log.event(
-                req.id, self._eid, "prefix_match", cached_tokens=offset)
-        suffix = Tp - offset
-        Tb = self._bucket(suffix, offset)
-        ids = np.zeros((1, Tb), np.int32)
-        ids[0, :suffix] = tokens[offset:]
-        fn = self._prefill_programs.get(Tb)
-        if fn is None:
-            fn = self._wrap_program(self._build_prefill(Tb),
-                                    f"prefill/{Tb}")
-            self._prefill_programs[Tb] = fn
-        param_datas = tuple(p.data()._data for p in self._params)
-        i32 = lambda v: jnp.asarray(v, jnp.int32)  # noqa: E731
-        t0 = self._clock()
-        with span("serving.prefill", engine=self._eid, bucket=Tb,
-                  cached_tokens=offset):
-            kp, vp, first, done0 = fn(
-                param_datas, self._kp, self._vp, jnp.asarray(ids),
-                jnp.asarray(self._table_host[slot]), i32(offset),
-                i32(suffix), i32(base), i32(req.seed),
-                jnp.asarray(req.temperature, jnp.float32),
-                i32(req.top_k), jnp.asarray(req.top_p, jnp.float32),
-                jnp.asarray(req.do_sample), i32(
-                    -1 if req.eos_token_id is None
-                    else req.eos_token_id),
-                *self._adapter_args(i32(self._aslot[slot])))
-            self._kp, self._vp = kp, vp
-            first = int(first)      # host sync: the prefill is done here
-        now = self._clock()
-        req.output_tokens.append(first)
-        req.token_times.append(now)
-        telemetry.request_log.event(
-            req.id, self._eid, "prefill", dur=now - t0, bucket=Tb,
-            suffix_tokens=suffix, first_token=first)
         m = self._metrics
-        m["prefills"].inc()
-        m["prefill_tokens"].inc(suffix)
-        m["tokens_emitted"].inc()
-        if not base:
-            # latency SLO metrics describe the FIRST admission only —
-            # a restart's wait is retry bookkeeping, not user TTFT
-            req.t_admit = now
-            m["admission_wait"].observe(t0 - req.t_submit)
-            m["ttft"].observe(now - req.t_submit)
-        m["prefill_seconds"].observe(now - t0)
-        self._account_flops(fn.program, now - t0)
         pc = self.prefix_cache
         if pc is not None:
+            telemetry.request_log.event(
+                req.id, self._eid, "prefix_match", cached_tokens=offset)
             if offset:
                 m["prefix_hits"].inc()
                 m["prefix_tokens_saved"].inc(offset)
             else:
                 m["prefix_misses"].inc()
-            # adopt the PROMPT's full pages into the radix tree: the
-            # next request sharing this prefix attaches instead of
-            # recomputing (prefill is host-synced above, so the page
-            # contents are final). On a restart the prompt still spans
-            # the same leading pages of the rebuilt table.
-            n_full = req.prompt_len // self.page_size
-            if n_full:
-                pc.insert(req.prompt,
-                          [int(p) for p in self._table_host[slot][:n_full]])
-        if pc is not None or self.adapter_pool is not None:
-            self._set_pool_gauges()
-        # budget: every decode step writes one KV; the last sampled token
-        # is never written, so a sequence of Tp supports up to
+        if not base:
+            # latency SLO metrics describe the FIRST admission only —
+            # a restart's wait is retry bookkeeping, not user TTFT
+            m["admission_wait"].observe(self._clock() - req.t_submit)
+        # budget: every decode step writes one KV; the last sampled
+        # token is never written, so a sequence of Tp supports up to
         # max_length - Tp + 1 further generated tokens; `base` already
-        # spent that much of max_new_tokens
+        # spent that much of max_new_tokens. The dispatch decrements
+        # remaining when the first token is emitted.
         cap = min(req.max_new_tokens - base, self.max_length - Tp + 1)
-        self._lengths[slot] = Tp
-        self._cur_tok[slot] = first
-        self._remaining[slot] = cap - 1
-        self._counters[slot] = base + 1
+        self._pending[slot] = np.asarray(tokens[offset:], np.int32)
+        self._base[slot] = base
+        self._lengths[slot] = offset
+        self._cur_tok[slot] = 0
+        self._remaining[slot] = cap
+        self._counters[slot] = base
         self._seeds[slot] = req.seed
         self._temp[slot] = req.temperature
         self._top_k[slot] = req.top_k
@@ -1695,45 +1687,58 @@ class ServingEngine:
         self._do_sample[slot] = req.do_sample
         self._eos[slot] = -1 if req.eos_token_id is None \
             else req.eos_token_id
-        self._done[slot] = bool(done0) or cap <= 1
-        if self._done[slot]:
-            return self._finish(slot)       # _release_slot syncs
+        self._done[slot] = False
         if self.speculative:
-            self._hist[slot] = list(tokens) + [first]
+            self._hist[slot] = None     # drafting starts after prefill
         self._sync_slot(slot)
+        m["prefill_pending"].set(self._pending_tokens())
+        if pc is not None or self.adapter_pool is not None:
+            self._set_pool_gauges()
         return None
 
-    # -- decode ------------------------------------------------------------
-    def _decode_fn(self, spec):
-        """The decode program for this dispatch: speculative or plain
-        (`spec` — a degraded speculative engine dispatches the PLAIN
-        program until recovery), greedy-only (no sort/RNG in-program)
-        when no active slot samples. All flavors are cached — at most
-        two compiles per mode, never per admission."""
+    def _pending_tokens(self):
+        return sum(int(p.size) for p in self._pending if p is not None)
+
+    # -- unified dispatch --------------------------------------------------
+    def _unified_fn(self):
+        """The unified program for this dispatch: greedy-only (no
+        sort/RNG in-program) when no active slot samples, the general
+        mixed-sampling flavor otherwise. Both are cached forever — two
+        compiles per engine lifetime, never per admission, never per
+        prompt length."""
         greedy_only = not bool(
             self._do_sample[self.scheduler.active_slots].any())
-        key = (spec, greedy_only)
-        fn = self._decode_programs.get(key)
+        fn = self._programs.get(greedy_only)
         if fn is None:
             variant = "greedy" if greedy_only else "sampled"
-            name = f"verify/S{self.spec_tokens}/{variant}" \
-                if spec else f"decode/{variant}"
-            # the plain decode program scans K steps per dispatch and
-            # XLA costs the scan body once — scale to per-dispatch
-            fn = self._wrap_program(
-                self._build_spec_decode(greedy_only) if spec
-                else self._build_decode(greedy_only), name,
-                cost_scale=1.0 if spec else float(self.decode_block))
-            self._decode_programs[key] = fn
+            name = (f"unified/W{self._width}/S{self.spec_tokens}"
+                    f"/{variant}" if self.speculative
+                    else f"unified/W{self._width}/{variant}")
+            fn = self._wrap_program(self._build_unified(greedy_only),
+                                    name)
+            self._programs[greedy_only] = fn
         return fn
 
-    def _build_decode(self, greedy_only=False):
+    def _build_unified(self, greedy_only=False):
+        """ONE fixed-shape program for every kind of work a slot can
+        carry in a dispatch (ISSUE 11 / ROADMAP §2): per-slot q_counts
+        route each of the B rows down the span kernel as a prefill
+        chunk (chunk_len), a decode step (1), a speculative verify
+        (1 + drafts), or idle (0). Dead query rows write no KV and emit
+        exact zeros, so activity is runtime DATA — the program's shape
+        never changes after its first compile."""
         model, params = self.model, self._params
-        K, impl = self.decode_block, self.attn_impl
+        W, impl = self._width, self.attn_impl
+        spec = self.speculative
+        S = self.spec_tokens
 
-        def decode(param_arrays, kp, vp, table, lock, lengths, cur_tok,
-                   done, remaining, counters, seeds, temp, top_k, top_p,
-                   do_sample, eos, *adapter):
+        def unified(param_arrays, kp, vp, table, lock, lengths, cur_tok,
+                    done, remaining, counters, seeds, temp, top_k,
+                    top_p, do_sample, eos, toks_in, chunk_len, is_final,
+                    decode_mask, *rest):
+            if spec:
+                drafts, n_draft, *rest = rest
+            adapter = tuple(rest)
             saved = [p._data for p in params]
             _trace_channel.push_frame()
             prev_ctx = None
@@ -1745,203 +1750,95 @@ class ServingEngine:
                     arr = NDArray(d)
                     arr._grad_req = "null"
                     p._data = arr
-
-                def body(carry, _):
-                    (kp, vp, lengths, cur_tok, done, remaining,
-                     counters, okc) = carry
-                    active = (~done) & (remaining > 0)
-                    cache = PagedKVCache(kp, vp, table, lengths,
-                                         page_lock=lock, attn_impl=impl)
-                    tok_in = jnp.where(active, cur_tok, 0)
-                    logits, cache = model.forward(
-                        NDArray(tok_in[:, None]), cache)
-                    step_logits = logits._data[:, -1, :]
-                    # in-program finite guard: a slot whose logits went
-                    # non-finite (corrupted KV, numeric blowup) is
-                    # flagged; the host discards its tokens from this
-                    # dispatch and re-prefills the request
-                    fin = jnp.isfinite(step_logits).all(axis=-1) \
-                        | ~active
-                    if greedy_only:
-                        nxt = jnp.argmax(step_logits,
-                                         axis=-1).astype(jnp.int32)
-                    else:
-                        keys = slot_keys(seeds, counters)
-                        nxt = sample_tokens(step_logits, keys,
-                                            do_sample, temp, top_k,
-                                            top_p)
-                    new_len = jnp.where(active, cache.length, lengths)
-                    new_rem = jnp.where(active, remaining - 1, remaining)
-                    hit_eos = (nxt == eos) & (eos >= 0)
-                    new_done = done | (active & (hit_eos
-                                                 | (new_rem <= 0)))
-                    carry = (cache.k_pages, cache.v_pages, new_len,
-                             jnp.where(active, nxt, cur_tok), new_done,
-                             new_rem,
-                             jnp.where(active, counters + 1, counters),
-                             okc & fin)
-                    return carry, (jnp.where(active, nxt, -1), active)
-
-                init = (kp, vp, lengths, cur_tok, done, remaining,
-                        counters, jnp.ones_like(done))
-                final, (toks, valid) = lax.scan(body, init, None,
-                                                length=K)
-            finally:
-                if adapter:
-                    _set_adapter_ctx(prev_ctx)
-                _trace_channel.pop_frame()
-                for p, d in zip(params, saved):
-                    p._data = d
-            return final + (toks, valid)
-
-        return jax.jit(decode, donate_argnums=(1, 2))
-
-    def _decode_block(self):
-        if self.speculative and not self._degraded:
-            return self._spec_decode_block()
-        self._fire_hook("decode",
-                        [self.scheduler.request_at(s)
-                         for s in self.scheduler.active_slots])
-        fn = self._decode_fn(False)
-        param_datas = tuple(p.data()._data for p in self._params)
-        st = self._dstate
-        (lengths, cur_tok, done, remaining, counters, seeds, temp,
-         top_k, top_p, do_sample, eos) = st[:11]
-        tail, table = st[11:-1], st[-1]   # (aslot,) with the pool on
-        t0 = self._clock()
-        with span("serving.decode_block", engine=self._eid,
-                  active=self.scheduler.num_active):
-            out = fn(
-                param_datas, self._kp, self._vp, table, self._d_lock,
-                lengths, cur_tok, done, remaining, counters, seeds,
-                temp, top_k, top_p, do_sample, eos,
-                *self._adapter_args(tail))
-            (self._kp, self._vp, lengths, cur_tok, done, remaining,
-             counters, okc, toks, valid) = out
-            self._dstate = (lengths, cur_tok, done, remaining, counters,
-                            seeds, temp, top_k, top_p, do_sample,
-                            eos) + tail + (table,)
-            # ONE host sync per K decoded tokens: everything small fetches
-            # together (the pools stay on device, donated through)
-            (self._lengths, self._cur_tok, self._done, self._remaining,
-             self._counters) = (
-                np.array(lengths), np.array(cur_tok), np.array(done),
-                np.array(remaining), np.array(counters))
-            toks, valid, ok = (np.asarray(toks), np.asarray(valid),
-                               np.asarray(okc))
-        now = self._clock()
-        dt = now - t0
-        m = self._metrics
-        m["decode_dispatches"].inc()
-        m["decode_steps"].inc(self.decode_block)
-        m["decode_seconds"].observe(dt)
-        rl = telemetry.request_log
-        finished = []
-        bad = []
-        n_emitted = 0
-        for slot in self.scheduler.active_slots:
-            req = self.scheduler.request_at(slot)
-            if not ok[slot]:
-                # non-finite logits: every token this dispatch sampled
-                # for the slot is garbage — discard them all, roll the
-                # request back (handled below, after accounting)
-                bad.append(slot)
-                continue
-            emitted = toks[valid[:, slot], slot]
-            req.output_tokens.extend(int(t) for t in emitted)
-            req.token_times.extend([now] * emitted.size)
-            # a clean dispatch clears the request's failure history —
-            # probation is for consecutive faults, not per-lifetime
-            req.dispatch_failures = 0
-            req.t_not_before = 0.0
-            if self.speculative and self._hist[slot] is not None:
-                # degraded spec engine decoding plainly: keep the
-                # history current so speculation resumes seamlessly
-                self._hist[slot].extend(int(t) for t in emitted)
-            if rl.enabled:
-                rl.event(req.id, self._eid, "decode", dur=dt,
-                         tokens=int(emitted.size))
-            n_emitted += int(emitted.size)
-            # block resolution: a slot that got n of this dispatch's
-            # tokens saw dt/n per token — the ACTUAL emitted count, not
-            # the nominal K (a slot can finish mid-block, and under
-            # speculation K is not the tokens-per-dispatch at all)
-            if emitted.size:
-                m["token_latency"].observe(dt / emitted.size,
-                                           int(emitted.size))
-            if self._done[slot] or self._remaining[slot] <= 0:
-                finished.append(self._finish(slot))
-        m["tokens_emitted"].inc(n_emitted)
-        self._account_flops(fn.program, dt)
-        if bad:
-            finished.extend(self._on_bad_slots(
-                bad, "non-finite logits in decode dispatch"))
-        return finished
-
-    # -- speculative decode ------------------------------------------------
-    def _build_spec_decode(self, greedy_only=False):
-        model, params = self.model, self._params
-        S, impl = self.spec_tokens, self.attn_impl
-
-        def decode(param_arrays, kp, vp, table, lock, lengths, cur_tok,
-                   done, remaining, counters, drafts, n_draft, seeds,
-                   temp, top_k, top_p, do_sample, eos, *adapter):
-            saved = [p._data for p in params]
-            _trace_channel.push_frame()
-            prev_ctx = None
-            if adapter:
-                aslot, a_A, a_B, a_scale = adapter
-                prev_ctx = _set_adapter_ctx((a_A, a_B, a_scale, aslot))
-            try:
-                for p, d in zip(params, param_arrays):
-                    arr = NDArray(d)
-                    arr._grad_req = "null"
-                    p._data = arr
-                active = (~done) & (remaining > 0)
-                nd = jnp.where(active, n_draft, 0)
+                active = decode_mask & (~done) & (remaining > 0)
+                prefilling = chunk_len > 0
+                finishing = prefilling & is_final
+                if spec:
+                    nd = jnp.where(active, n_draft, 0)
+                    qn = jnp.where(prefilling, chunk_len,
+                                   jnp.where(active, 1 + nd, 0))
+                else:
+                    qn = jnp.where(prefilling, chunk_len,
+                                   jnp.where(active, 1, 0))
                 cache = PagedKVCache(kp, vp, table, lengths,
-                                     page_lock=lock, attn_impl=impl)
-                # ONE forward over [current token, drafts]: the model
-                # writes all S positions' KV at lengths..lengths+S-1 and
-                # the multi-query ragged kernel applies the per-position
-                # causal offsets; logits[:, j] is the distribution of
-                # the token after prefix..draft_j
-                toks_in = jnp.concatenate(
-                    [jnp.where(active, cur_tok, 0)[:, None],
-                     jnp.where(active[:, None], drafts, 0)], axis=1)
+                                     page_lock=lock, spans=qn,
+                                     attn_impl=impl)
                 logits, cache = model.forward(NDArray(toks_in), cache)
-                # in-program finite guard (see _build_decode): flag any
-                # slot whose verification logits went non-finite
-                ok = jnp.isfinite(logits._data).all(axis=(1, 2)) \
-                    | ~active
-                emitted, n_acc = verify_tokens(
-                    logits._data, drafts, nd, seeds, counters,
-                    do_sample, temp, top_k, top_p,
-                    greedy_only=greedy_only)
-                pos = jnp.arange(S)[None, :]
-                # emit the accepted drafts + one verifier token, capped
-                # by the remaining budget, truncated at the first eos;
-                # only the emitted count advances `lengths` — rejected
-                # drafts' KV stays behind the length (invisible) and is
-                # overwritten in place by the next dispatch
-                n_em = jnp.minimum(n_acc + 1, remaining)
-                hit = ((emitted == eos[:, None]) & (eos >= 0)[:, None]
-                       & (pos < n_em[:, None]))
-                any_hit = hit.any(axis=1)
-                n_em = jnp.where(
-                    any_hit, jnp.minimum(n_em, jnp.argmax(hit, 1) + 1),
-                    n_em)
-                n_em = jnp.where(active, n_em, 0)
-                toks = jnp.where(pos < n_em[:, None], emitted, -1)
-                last = jnp.take_along_axis(
-                    emitted, jnp.maximum(n_em - 1, 0)[:, None],
+                lg = logits._data
+                pos = jnp.arange(W)[None, :]
+                live = pos < qn[:, None]
+                # in-program finite guard over LIVE positions only: a
+                # slot whose logits went non-finite (corrupted KV,
+                # numeric blowup) is flagged; the host discards its
+                # tokens from this dispatch and re-prefills the request
+                ok = jnp.isfinite(
+                    jnp.where(live[:, :, None], lg, 0.0)
+                ).all(axis=(1, 2)) | ~(active | prefilling)
+                # the token every non-verify row samples: a decode row
+                # reads position 0, a finishing prefill reads its last
+                # live position — the distribution of the token after
+                # the full prompt
+                sel = jnp.take_along_axis(
+                    lg, jnp.maximum(chunk_len - 1, 0)[:, None, None],
                     axis=1)[:, 0]
-                new_len = jnp.where(active, lengths + n_em, lengths)
-                new_rem = jnp.where(active, remaining - n_em, remaining)
-                new_done = done | (active & (any_hit | (new_rem <= 0)))
-                new_cur = jnp.where(active, last, cur_tok)
-                new_cnt = jnp.where(active, counters + n_em, counters)
-                n_acc_em = jnp.minimum(n_acc, n_em)   # drafts EMITTED
+                if greedy_only:
+                    nxt = jnp.argmax(sel, axis=-1).astype(jnp.int32)
+                else:
+                    keys = slot_keys(seeds, counters)
+                    nxt = sample_tokens(sel, keys, do_sample, temp,
+                                        top_k, top_p)
+                if spec:
+                    emitted, n_acc = verify_tokens(
+                        lg[:, :S], drafts, nd, seeds, counters,
+                        do_sample, temp, top_k, top_p,
+                        greedy_only=greedy_only)
+                    vpos = jnp.arange(S)[None, :]
+                    # emit the accepted drafts + one verifier token,
+                    # capped by the remaining budget, truncated at the
+                    # first eos; only the emitted count advances
+                    # `lengths` — rejected drafts' KV stays behind the
+                    # length (invisible) and is overwritten in place
+                    n_em = jnp.minimum(n_acc + 1, remaining)
+                    hit = ((emitted == eos[:, None])
+                           & (eos >= 0)[:, None]
+                           & (vpos < n_em[:, None]))
+                    any_hit = hit.any(axis=1)
+                    n_em = jnp.where(
+                        any_hit,
+                        jnp.minimum(n_em, jnp.argmax(hit, 1) + 1),
+                        n_em)
+                    n_em = jnp.where(active, n_em, 0)
+                    # a finishing prefill emits exactly its first token
+                    n_em = jnp.where(finishing, 1, n_em)
+                    toks = jnp.where(vpos < n_em[:, None], emitted, -1)
+                    toks = jnp.where(
+                        finishing[:, None],
+                        jnp.where(vpos == 0, nxt[:, None], -1), toks)
+                    last = jnp.take_along_axis(
+                        emitted, jnp.maximum(n_em - 1, 0)[:, None],
+                        axis=1)[:, 0]
+                    last = jnp.where(finishing, nxt, last)
+                    stop = jnp.where(finishing,
+                                     (nxt == eos) & (eos >= 0), any_hit)
+                    n_acc_em = jnp.minimum(n_acc, n_em)
+                else:
+                    n_em = jnp.where(active | finishing, 1, 0)
+                    toks = jnp.where((active | finishing)[:, None],
+                                     nxt[:, None], -1)
+                    last = nxt
+                    stop = (nxt == eos) & (eos >= 0)
+                    n_acc_em = jnp.zeros_like(n_em)
+                emit = active | finishing
+                # a prefill chunk advances by the tokens it FED (the
+                # first sampled token is never written — the next
+                # decode writes it); a verify row by the tokens emitted
+                adv = jnp.where(prefilling, chunk_len,
+                                jnp.where(active, n_em, 0))
+                new_len = lengths + adv
+                new_rem = remaining - jnp.where(emit, n_em, 0)
+                new_done = done | (emit & (stop | (new_rem <= 0)))
+                new_cur = jnp.where(emit, last, cur_tok)
+                new_cnt = counters + jnp.where(emit, n_em, 0)
             finally:
                 if adapter:
                     _set_adapter_ctx(prev_ctx)
@@ -1952,40 +1849,78 @@ class ServingEngine:
                     new_done, new_rem, new_cnt, ok, toks, n_em,
                     n_acc_em)
 
-        return jax.jit(decode, donate_argnums=(1, 2))
+        return jax.jit(unified, donate_argnums=(1, 2))
 
-    def _spec_decode_block(self):
-        self._fire_hook("decode",
-                        [self.scheduler.request_at(s)
-                         for s in self.scheduler.active_slots])
-        fn = self._decode_fn(True)
-        B, S = self.num_slots, self.spec_tokens
-        drafts = np.zeros((B, S - 1), np.int32)
+    def _dispatch(self):
+        """ONE unified dispatch: assemble the per-slot work rows
+        (prefill chunk / decode / verify / idle) on the host, run the
+        fixed-shape program, then fan the results back out — emitted
+        tokens, chunk-cursor advances, first tokens of prompts whose
+        final chunk landed, and finish/rollback bookkeeping."""
+        spec = self.speculative
+        spec_on = spec and not self._degraded
+        B, W = self.num_slots, self._width
+        S = self.spec_tokens if spec else 1
+        toks_in = np.zeros((B, W), np.int32)
+        chunk_len = np.zeros(B, np.int32)
+        is_final = np.zeros(B, bool)
+        decode_mask = np.zeros(B, bool)
+        drafts = np.zeros((B, S - 1), np.int32) if spec else None
         n_draft = np.zeros(B, np.int32)
-        for slot in self.scheduler.active_slots:
-            d = self._proposer.propose(self._hist[slot])
-            n_draft[slot] = d.size
-            drafts[slot, :d.size] = d
+        budget = self.prefill_chunk_budget
+        active_slots = list(self.scheduler.active_slots)
+        self._fire_hook("decode", [self.scheduler.request_at(s)
+                                   for s in active_slots])
+        # prefill-budget fairness: visit slots round-robin from a
+        # rotating cursor, so concurrent long prompts take turns when
+        # the budget can't cover everyone each dispatch
+        for slot in sorted(active_slots,
+                           key=lambda s: (s - self._chunk_rr) % B):
+            pend = self._pending[slot]
+            if pend is not None and pend.size:
+                n = min(int(pend.size), self.chunk_tokens, budget)
+                if n <= 0:
+                    continue        # budget spent: the chunk waits
+                budget -= n
+                toks_in[slot, :n] = pend[:n]
+                chunk_len[slot] = n
+                is_final[slot] = n == pend.size
+            elif not self._done[slot] and self._remaining[slot] > 0:
+                decode_mask[slot] = True
+                toks_in[slot, 0] = self._cur_tok[slot]
+                if spec_on and self._hist[slot] is not None:
+                    d = self._proposer.propose(self._hist[slot])
+                    n_draft[slot] = d.size
+                    drafts[slot, :d.size] = d
+                    toks_in[slot, 1:1 + d.size] = d
+        self._chunk_rr = (self._chunk_rr + 1) % B
+        fn = self._unified_fn()
         param_datas = tuple(p.data()._data for p in self._params)
         st = self._dstate
         (lengths, cur_tok, done, remaining, counters, seeds, temp,
          top_k, top_p, do_sample, eos) = st[:11]
         tail, table = st[11:-1], st[-1]   # (aslot,) with the pool on
+        extra = (jnp.asarray(drafts), jnp.asarray(n_draft)) \
+            if spec else ()
         t0 = self._clock()
-        with span("serving.spec_decode", engine=self._eid,
-                  active=self.scheduler.num_active,
+        with span("serving.dispatch", engine=self._eid,
+                  active=len(active_slots),
+                  prefill_tokens=int(chunk_len.sum()),
                   drafted=int(n_draft.sum())):
             out = fn(
                 param_datas, self._kp, self._vp, table, self._d_lock,
-                lengths, cur_tok, done, remaining, counters,
-                jnp.asarray(drafts), jnp.asarray(n_draft), seeds, temp,
-                top_k, top_p, do_sample, eos,
-                *self._adapter_args(tail))
+                lengths, cur_tok, done, remaining, counters, seeds,
+                temp, top_k, top_p, do_sample, eos,
+                jnp.asarray(toks_in), jnp.asarray(chunk_len),
+                jnp.asarray(is_final), jnp.asarray(decode_mask),
+                *extra, *self._adapter_args(tail))
             (self._kp, self._vp, lengths, cur_tok, done, remaining,
              counters, okc, toks, n_em, n_acc) = out
             self._dstate = (lengths, cur_tok, done, remaining, counters,
                             seeds, temp, top_k, top_p, do_sample,
                             eos) + tail + (table,)
+            # ONE host sync per dispatch: everything small fetches
+            # together (the pools stay on device, donated through)
             (self._lengths, self._cur_tok, self._done, self._remaining,
              self._counters) = (
                 np.array(lengths), np.array(cur_tok), np.array(done),
@@ -1997,50 +1932,123 @@ class ServingEngine:
         dt = now - t0
         m = self._metrics
         m["decode_dispatches"].inc()
-        m["decode_steps"].inc()          # one verification forward
+        m["decode_steps"].inc()
         m["decode_seconds"].observe(dt)
+        n_chunks = int((chunk_len > 0).sum())
+        if n_chunks:
+            m["prefill_chunks"].inc(n_chunks)
+            m["prefill_tokens"].inc(int(chunk_len.sum()))
+            m["prefill_seconds"].observe(dt)
         rl = telemetry.request_log
         finished = []
         bad = []
         n_emitted = 0
         accepted = 0
-        for slot in self.scheduler.active_slots:
+        for slot in active_slots:
             req = self.scheduler.request_at(slot)
             if not ok[slot]:
+                # non-finite logits: every token this dispatch produced
+                # for the slot is garbage — discard it all, roll the
+                # request back (handled below, after accounting)
                 bad.append(slot)
                 continue
+            cl = int(chunk_len[slot])
+            if cl:
+                self._pending[slot] = self._pending[slot][cl:]
+                if rl.enabled:
+                    rl.event(req.id, self._eid, "prefill_chunk",
+                             dur=dt, tokens=cl,
+                             final=bool(is_final[slot]))
+                if not is_final[slot]:
+                    req.dispatch_failures = 0
+                    req.t_not_before = 0.0
+                    continue
+                # final chunk: the request's first token landed in the
+                # same dispatch — the slot decodes from the next tick
+                self._pending[slot] = None
+                first = int(toks[slot, 0])
+                req.output_tokens.append(first)
+                req.token_times.append(now)
+                req.dispatch_failures = 0
+                req.t_not_before = 0.0
+                req.status = "running"
+                rl.event(req.id, self._eid, "prefill", dur=dt,
+                         first_token=first)
+                m["prefills"].inc()
+                n_emitted += 1
+                if not self._base[slot]:
+                    req.t_admit = now
+                    ttft = now - req.t_submit
+                    m["ttft"].observe(ttft)
+                    self._observe_ttft(req.prompt_len, ttft)
+                pc = self.prefix_cache
+                if pc is not None:
+                    # adopt the PROMPT's full pages into the radix
+                    # tree: the next request sharing this prefix
+                    # attaches instead of recomputing. Membership
+                    # changes the page_lock mask — refresh the device
+                    # copy before the next dispatch.
+                    n_full = req.prompt_len // self.page_size
+                    if n_full:
+                        pc.insert(
+                            req.prompt,
+                            [int(p)
+                             for p in self._table_host[slot][:n_full]])
+                        self._d_lock = jnp.asarray(
+                            self._page_lock_host())
+                    self._set_pool_gauges()
+                if spec:
+                    self._hist[slot] = [int(t) for t in req.prompt] \
+                        + [int(t) for t in req.output_tokens]
+                if self._done[slot] or self._remaining[slot] <= 0:
+                    finished.append(self._finish(slot))
+                continue
+            if not decode_mask[slot]:
+                continue            # chunk queued but out of budget
             n = int(n_em[slot])
             emitted = [int(t) for t in toks[slot, :n]]
             req.output_tokens.extend(emitted)
             req.token_times.extend([now] * n)
+            # a clean dispatch clears the request's failure history —
+            # probation is for consecutive faults, not per-lifetime
             req.dispatch_failures = 0
             req.t_not_before = 0.0
-            if rl.enabled:
-                rl.event(req.id, self._eid, "verify", dur=dt,
-                         drafted=int(n_draft[slot]),
-                         accepted=int(n_acc[slot]), tokens=n)
-            if self._hist[slot] is not None:
+            if spec and self._hist[slot] is not None:
                 self._hist[slot].extend(emitted)
+            if rl.enabled:
+                if spec:
+                    rl.event(req.id, self._eid, "verify", dur=dt,
+                             drafted=int(n_draft[slot]),
+                             accepted=int(n_acc[slot]), tokens=n)
+                else:
+                    rl.event(req.id, self._eid, "decode", dur=dt,
+                             tokens=n)
             n_emitted += n
             accepted += int(n_acc[slot])
+            # dispatch resolution: a slot that got n of this dispatch's
+            # tokens saw dt/n per token — the ACTUAL emitted count
             if n:
                 m["token_latency"].observe(dt / n, n)
             if self._done[slot] or self._remaining[slot] <= 0:
                 finished.append(self._finish(slot))
         m["tokens_emitted"].inc(n_emitted)
-        drafted = int(n_draft.sum())
-        m["spec_draft_tokens"].inc(drafted)
-        m["spec_accepted_tokens"].inc(accepted)
-        m["spec_rollbacks"].inc(drafted - accepted)
-        # goodput: the verify program computes B x S query positions a
-        # dispatch; the drafted-but-rejected share of them is speculation
-        # waste (inactive-slot padding is a separate, structural cost)
-        self._account_flops(
-            fn.program, dt,
-            wasted_fraction=(drafted - accepted) / (B * S))
+        m["prefill_pending"].set(self._pending_tokens())
+        if spec:
+            drafted = int(n_draft.sum())
+            m["spec_draft_tokens"].inc(drafted)
+            m["spec_accepted_tokens"].inc(accepted)
+            m["spec_rollbacks"].inc(drafted - accepted)
+            # goodput: the unified program computes B x W query
+            # positions a dispatch; the drafted-but-rejected share is
+            # speculation waste (idle padding is a separate,
+            # structural cost the MFU gauges already show)
+            waste = (drafted - accepted) / (B * W)
+        else:
+            waste = 0.0
+        self._account_flops(fn.program, dt, wasted_fraction=waste)
         if bad:
             finished.extend(self._on_bad_slots(
-                bad, "non-finite logits in verification dispatch"))
+                bad, "non-finite logits in unified dispatch"))
         return finished
 
     def _release_slot(self, slot):
@@ -2049,6 +2057,7 @@ class ServingEngine:
         (length = max_length) so the recycled pages can't be touched."""
         req = self.scheduler.release(slot)
         req.t_finish = self._clock()
+        self._pending[slot] = None
         self._done[slot] = True
         self._remaining[slot] = 0
         self._lengths[slot] = self.max_length

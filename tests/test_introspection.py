@@ -239,7 +239,7 @@ def test_chrome_trace_schema_and_nesting():
     names = {e["name"] for e in trace["traceEvents"]}
     assert {"request", "queued", "prefill", "decode"} <= names
     # span events ride in pid 0
-    assert any(e["pid"] == 0 and e["name"] == "serving.decode_block"
+    assert any(e["pid"] == 0 and e["name"] == "serving.dispatch"
                for e in trace["traceEvents"] if e["ph"] == "X")
     # the last_ms window drops everything for a 0-width window
     assert telemetry.chrome_trace(last_ms=0.0)["traceEvents"] == [] \

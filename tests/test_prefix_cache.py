@@ -347,21 +347,31 @@ def test_engine_prefix_cache_disabled_pool_drains_clean():
     assert (eng.page_pool.refcounts() == 0).all()
 
 
-def test_engine_intra_batch_sharing_same_round():
-    """Two requests with the same prompt admitted in the same scheduling
-    round: the second attaches the first's pages while the first is
-    still decoding (refcount > 1 on the shared pages mid-flight)."""
+def test_engine_mid_flight_sharing():
+    """A second request with the same prompt admitted while the first
+    is STILL decoding attaches the first's pages mid-flight (refcount
+    > 1 on the shared pages). Under chunked prefill the prompt's pages
+    enter the radix tree when its FINAL chunk lands — a same-round
+    co-admission can't share (the shared KV doesn't exist yet), but
+    any admission after that dispatch does."""
     net, cfg = _tiny()
     rng = np.random.default_rng(16)
     prompt = rng.integers(0, cfg.vocab_size, 16).tolist()
     eng = ServingEngine(net, num_slots=2, max_length=64, page_size=8,
-                        decode_block=2, attn_impl="xla", prefix_cache=True)
+                        attn_impl="xla", prefix_cache=True,
+                        chunk_tokens=8)
     r1 = Request(prompt, 8, request_id="x")
-    r2 = Request(prompt, 8, request_id="y")
     eng.submit(r1)
+    # two 8-token chunks: the final one lands on the second dispatch
+    # and adopts the prompt's pages into the tree
+    eng.step()
+    eng.step()
+    assert len(r1.output_tokens) >= 1       # first token landed
+    r2 = Request(prompt, 8, request_id="y")
     eng.submit(r2)
-    eng.step()                              # both admitted this round
+    eng.step()                              # r2 attaches, r1 mid-decode
     assert eng.stats["prefix_pages_shared"] >= 1
+    assert r1.status == "running"
     while eng.has_work:
         eng.step()
     assert r1.output_tokens == r2.output_tokens

@@ -390,16 +390,32 @@ def test_generate_cache_is_bounded():
         del os.environ["MXNET_TPU_GENERATE_CACHE_SIZE"]
 
 
-def test_prefill_program_cache_is_bounded():
+def test_program_registry_is_flat():
+    """The unified dispatch kills the prefill bucket axis: arbitrary
+    prompt lengths — including lengths never seen in warmup — compile
+    NOTHING new. At most two programs exist per engine lifetime
+    (greedy-only and mixed-sampling flavors)."""
     net, cfg = _tiny()
     eng = ServingEngine(net, num_slots=1, max_length=64, page_size=8,
-                        decode_block=1, attn_impl="xla")
-    eng._prefill_programs.maxsize = 2
+                        attn_impl="xla")
     rng = np.random.default_rng(7)
-    for n in (3, 11, 19, 27):                  # four distinct buckets
+    for n in (3, 11, 19, 27):       # four different prompt lengths...
         eng.serve([Request(rng.integers(0, cfg.vocab_size, n).tolist(),
                            2)])
-    assert len(eng._prefill_programs) == 2
+    assert len(eng._programs) == 1  # ...ONE greedy program serves all
+    eng.serve([Request([1, 2, 3], 2, do_sample=True, seed=0)])
+    assert len(eng._programs) == 2  # plus the mixed-sampling flavor
+    eng.mark_warm()
+    from mxnet_tpu.telemetry import cost as _cost
+    before = {fn.program: _cost.get(fn.program)["compiles"]
+              for fn in eng._programs.values()}
+    for n in (5, 23, 31):           # lengths the engine has NEVER seen
+        eng.serve([Request(rng.integers(0, cfg.vocab_size, n).tolist(),
+                           2)])
+    assert len(eng._programs) == 2
+    after = {fn.program: _cost.get(fn.program)["compiles"]
+             for fn in eng._programs.values()}
+    assert after == before          # steady state: zero new compiles
 
 
 # ---------------------------------------------------------------------------

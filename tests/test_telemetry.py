@@ -315,7 +315,11 @@ def test_serving_engine_metrics_end_to_end():
     assert s["prefills"] == len(reqs)
     assert s["requests_rejected"] == 0
     assert s["queue_depth"] == 0 and s["slot_occupancy"] == 0
-    assert s["decode_steps"] == s["decode_dispatches"] * eng.decode_block
+    # unified dispatch: one model forward per dispatch, whatever mix
+    # of chunk/decode rows it carried
+    assert s["decode_steps"] == s["decode_dispatches"]
+    assert s["prefill_chunks"] >= len(reqs)
+    assert s["prefill_pending"] == 0           # everything drained
 
     # engine-local reset leaves identity intact and zeroes counts
     eng.reset_stats()

@@ -42,7 +42,7 @@ __all__ = ["load_records", "compare", "main"]
 
 _LOWER_BETTER = ("latency", "_ms", "seconds", "bytes", "loss",
                  "overhead", "ttft", "mismatch", "page_in", "eviction",
-                 "compiles", "shed")
+                 "compiles", "shed", "pending", "makespan", "stall")
 
 
 def lower_is_better(name):
