@@ -1740,6 +1740,268 @@ def bench_gpt2_serving_chunked():
     return 0 if ok else 1
 
 
+def bench_gpt2_serving_http():
+    """HTTP ingress overhead + robustness: the SAME greedy Poisson
+    stream served (A) in-process — requests submitted straight into a
+    ServingEngine stepped by this thread — and (B) through a live
+    ServingFrontend over real sockets, one client thread per request.
+    After phase B a burst of seeded disconnect clients hangs up
+    mid-stream on the same frontend (the recovery count). Pass
+    criteria: ZERO greedy mismatches between the offline reference and
+    every stream both phases produced, every disconnect detected and
+    cancelled with clean audits, zero steady-state compiles in either
+    phase, and ingress overhead in bounds: HTTP makespan within
+    BENCH_HTTP_OVERHEAD_MAX (default 5%) of in-process OR added cost
+    under BENCH_HTTP_INGRESS_MS_MAX (default 5) milliseconds per
+    request. The fractional gate is the meaningful one at paper scale,
+    where per-request service runs seconds; the absolute per-request
+    bound keeps the CPU smoke config (~4 ms of service per request)
+    from failing on fixed socket/GIL costs that are noise at scale.
+    Reports client-observable TTFB p50/p99 (request sent -> first
+    tokens SSE event) against the engine's own TTFT p50/p99."""
+    import json as _json
+    import socket
+    import threading
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.models import GPT2ForCausalLM, gpt2_774m_config
+    from mxnet_tpu.serving import (Request, ServingEngine,
+                                   ServingFrontend)
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8))
+    block = int(os.environ.get("BENCH_SERVE_BLOCK", 8))
+    n_requests = int(os.environ.get("BENCH_HTTP_REQUESTS",
+                                    64 if on_tpu else 48))
+    n_disc = int(os.environ.get("BENCH_HTTP_DISCONNECTS", 8))
+    overhead_max = float(os.environ.get("BENCH_HTTP_OVERHEAD_MAX", 0.05))
+    ingress_ms_max = float(os.environ.get("BENCH_HTTP_INGRESS_MS_MAX",
+                                          5.0))
+    cfg = gpt2_774m_config(dtype="bfloat16" if on_tpu else "float32",
+                           dropout=0.0, attention_dropout=0.0)
+    max_len, page = 1024, 64
+    p_lo, p_hi, o_lo, o_hi = 16, 128, 32, 128
+    if not on_tpu:  # CPU smoke config
+        cfg.vocab_size, cfg.units, cfg.hidden_size = 512, 64, 256
+        cfg.num_layers, cfg.num_heads, cfg.max_length = 2, 2, 64
+        max_len, page = 64, 8
+        p_lo, p_hi, o_lo, o_hi = 2, 12, 4, 12
+        slots, block = min(slots, 4), min(block, 4)
+
+    net = GPT2ForCausalLM(cfg)
+    net.initialize(mx.init.Normal(0.02))
+    if on_tpu:
+        net.cast("bfloat16")
+
+    rng = np.random.default_rng(41)
+    bodies = [{"prompt": rng.integers(
+                   0, cfg.vocab_size,
+                   int(rng.integers(p_lo, p_hi + 1))).tolist(),
+               "max_new_tokens": int(rng.integers(o_lo, o_hi + 1))}
+              for _ in range(n_requests)]
+
+    def new_engine():
+        eng = ServingEngine(net, num_slots=slots, max_length=max_len,
+                            page_size=page, decode_block=block)
+        # warm every prefill bucket, including the ones only a
+        # re-prefill of prompt+emitted can land in
+        eng.serve([Request(list(range(1, b + 1)), 2,
+                           request_id=f"w{b}")
+                   for b in range(page, min(p_hi + o_hi + page, max_len),
+                                  page)])
+        eng.mark_warm()
+        eng.reset_stats()
+        return eng
+
+    def ttft_ms(eng, q):
+        kid = telemetry.get("serving_ttft_seconds").labels(eng._eid)
+        return round(kid.percentile(q) * 1e3, 2) if kid.count else None
+
+    # offline greedy reference + closed-loop capacity probe
+    ref_eng = new_engine()
+    refs = [Request(b["prompt"], b["max_new_tokens"],
+                    request_id=f"ref-{i}")
+            for i, b in enumerate(bodies)]
+    t0 = time.perf_counter()
+    ref_eng.serve(refs)
+    capacity_rps = n_requests / (time.perf_counter() - t0)
+    assert all(r.status == "finished" for r in refs)
+    reference = [list(r.output_tokens) for r in refs]
+    rate = 0.8 * capacity_rps       # below the knee: the comparison
+                                    # should expose ingress cost, not
+                                    # shared queueing delay
+    arr = np.cumsum(np.random.default_rng(43).exponential(
+        1.0 / rate, n_requests))
+
+    # phase A: the same open-loop stream, in-process
+    eng_a = new_engine()
+    ca0 = _engine_compiles(eng_a._eid)
+    reqs_a = [Request(b["prompt"], b["max_new_tokens"],
+                      request_id=f"a-{i}")
+              for i, b in enumerate(bodies)]
+    t0 = time.perf_counter()
+    pending = list(zip(arr, reqs_a))
+    while pending or eng_a.has_work:
+        now = time.perf_counter() - t0
+        while pending and pending[0][0] <= now:
+            eng_a.submit(pending.pop(0)[1])
+        if eng_a.has_work:
+            eng_a.step()
+        elif pending:
+            time.sleep(min(pending[0][0] - now, 0.005))
+    makespan_a = time.perf_counter() - t0
+    mismatch_a = sum(list(r.output_tokens) != reference[i]
+                     for i, r in enumerate(reqs_a))
+
+    def sse_tokens(raw):
+        toks = []
+        body = raw.partition(b"\r\n\r\n")[2].decode(errors="replace")
+        for block_ in body.split("\n\n"):
+            ev = data = None
+            for line in block_.strip().splitlines():
+                if line.startswith("event: "):
+                    ev = line[7:]
+                elif line.startswith("data: "):
+                    data = line[6:]
+            if ev == "tokens" and data:
+                toks.extend(_json.loads(data)["tokens"])
+        return toks
+
+    # phase B: the same open-loop stream, over real sockets
+    eng_b = new_engine()
+    cb0 = _engine_compiles(eng_b._eid)
+    fe = ServingFrontend(eng_b, keepalive_s=0.05, step_idle_s=0.002)
+    out = {}
+
+    def client(i, body, rid, cutoff_first_token=False):
+        payload = _json.dumps(dict(body, request_id=rid)).encode()
+        t_send = time.perf_counter()
+        raw, ttfb = b"", None
+        sock = socket.create_connection((fe.host, fe.port), timeout=600)
+        try:
+            sock.sendall(b"POST /v1/generate HTTP/1.0\r\n"
+                         b"Content-Type: application/json\r\n"
+                         b"Content-Length: " + str(len(payload)).encode()
+                         + b"\r\n\r\n" + payload)
+            while True:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    break
+                raw += chunk
+                if ttfb is None and b"event: tokens" in raw:
+                    ttfb = time.perf_counter() - t_send
+                    if cutoff_first_token:
+                        break       # hang up mid-stream, no goodbye
+        finally:
+            sock.close()
+        out[rid] = (ttfb, raw, time.perf_counter() - t_send)
+
+    try:
+        threads = []
+        t0 = time.perf_counter()
+        for i, (at, body) in enumerate(zip(arr, bodies)):
+            lag = at - (time.perf_counter() - t0)
+            if lag > 0:
+                time.sleep(lag)
+            th = threading.Thread(target=client,
+                                  args=(i, body, f"b-{i}"), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=600)
+        while eng_b.has_work or fe.stats["active_streams"]:
+            time.sleep(0.002)
+        makespan_b = time.perf_counter() - t0
+        mismatch_b = sum(
+            sse_tokens(out[f"b-{i}"][1]) != reference[i]
+            for i in range(n_requests))
+        ttfbs = np.array([out[f"b-{i}"][0] for i in range(n_requests)
+                          if out[f"b-{i}"][0] is not None])
+
+        # disconnect burst: the recovery count on the same frontend
+        disc0 = eng_b.stats["requests_cancelled"]
+        dthreads = []
+        for i in range(n_disc):
+            body = {"prompt": bodies[i]["prompt"],
+                    "max_new_tokens": min(2 * o_hi,
+                                          max_len - p_hi - page)}
+            th = threading.Thread(
+                target=client, args=(i, body, f"d-{i}", True),
+                daemon=True)
+            th.start()
+            dthreads.append(th)
+        for th in dthreads:
+            th.join(timeout=600)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if not eng_b.has_work and fe.stats["active_streams"] == 0 \
+                    and fe._cmd_q.empty():
+                break
+            time.sleep(0.01)
+        recovered = eng_b.stats["requests_cancelled"] - disc0
+        fstats = fe.stats
+    finally:
+        fe.close()
+
+    overhead = makespan_b / max(makespan_a, 1e-9) - 1.0
+    ingress_ms = (makespan_b - makespan_a) * 1e3 / n_requests
+    steady_a = _engine_compiles(eng_a._eid) - ca0
+    steady_b = _engine_compiles(eng_b._eid) - cb0
+    leaks = (len(eng_a.audit_pages()) + len(eng_b.audit_pages())
+             + len(eng_a.audit_adapters()) + len(eng_b.audit_adapters()))
+    ttfb_p50 = round(float(np.percentile(ttfbs, 50)) * 1e3, 2) \
+        if ttfbs.size else None
+    ttfb_p99 = round(float(np.percentile(ttfbs, 99)) * 1e3, 2) \
+        if ttfbs.size else None
+    _emit("gpt2_serving_http_ttfb_p99_ms", ttfb_p99 or 0.0, "ms",
+          round(1.0 + overhead, 4), extras={
+              "ttfb_p50_ms": ttfb_p50,
+              "ttfb_p99_ms": ttfb_p99,
+              "ttft_inproc_p50_ms": ttft_ms(eng_a, 50),
+              "ttft_inproc_p99_ms": ttft_ms(eng_a, 99),
+              "ttft_http_p50_ms": ttft_ms(eng_b, 50),
+              "ttft_http_p99_ms": ttft_ms(eng_b, 99),
+              "ingress_overhead": round(overhead, 4),
+              "ingress_overhead_max": overhead_max,
+              "ingress_ms_per_request": round(ingress_ms, 3),
+              "ingress_ms_max": ingress_ms_max,
+              "makespan_inproc_s": round(makespan_a, 3),
+              "makespan_http_s": round(makespan_b, 3),
+              "greedy_mismatches_inproc": mismatch_a,
+              "greedy_mismatches_http": mismatch_b,
+              "disconnect_clients": n_disc,
+              "disconnects_detected": fstats["disconnects"],
+              "disconnects_recovered": recovered,
+              "cancels_issued": fstats["cancels_issued"],
+              "cancels_noop": fstats["cancels_noop"],
+              "requests_by_code": fstats["requests_by_code"],
+              "steady_state_compiles_inproc": steady_a,
+              "steady_state_compiles_http": steady_b,
+              "audit_leaks": leaks,
+              "requests": n_requests, "slots": slots,
+              "decode_block": block,
+              "capacity_req_per_sec": round(capacity_rps, 3),
+              "offered_req_per_sec": round(rate, 3),
+              "prompt_lens": f"U[{p_lo},{p_hi}]",
+              "output_lens": f"U[{o_lo},{o_hi}] (greedy)",
+              "arrivals": f"poisson({round(rate, 2)}/s)",
+              "params": cfg.num_params(),
+              "device": str(dev.device_kind),
+              "baseline": "phase A above (same stream submitted "
+                          "in-process, no HTTP)",
+          })
+    ok = (mismatch_a == 0 and mismatch_b == 0
+          and fstats["disconnects"] == n_disc
+          and fstats["cancels_issued"] + fstats["cancels_noop"]
+          == fstats["disconnects"]
+          and steady_a == 0 and steady_b == 0 and leaks == 0
+          and (overhead <= overhead_max or ingress_ms <= ingress_ms_max))
+    return 0 if ok else 1
+
+
 def bench_longcontext():
     """Long-context attention: fwd+bwd through the blockwise flash path
     at sequence lengths whose (T, T) score matrix would not fit
@@ -1900,6 +2162,9 @@ def main():
     if workload in ("serving_chunked", "chunked", "chunked_prefill",
                     "gpt2_serving_chunked"):
         return bench_gpt2_serving_chunked()
+    if workload in ("serving_http", "http", "frontend",
+                    "gpt2_serving_http"):
+        return bench_gpt2_serving_http()
     if workload == "decode":
         return bench_decode()
     if workload in ("longcontext", "long"):

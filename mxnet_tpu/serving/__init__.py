@@ -53,12 +53,21 @@ compiled program (zero retraces across adapter churn), and
 `TenantQuota` + the scheduler's deficit-weighted fair pick keep one
 tenant from starving the rest.
 
+The HTTP ingress (docs/SERVING.md "HTTP front-end"):
+`ServingFrontend` (serving/frontend.py) exposes an engine or router
+as `POST /v1/generate` with SSE token streaming over the stdlib
+HTTP stack — bounded per-stream buffers (`TokenStream`) with a
+slow-client overflow-cancel policy, client disconnects wired to
+idempotent `cancel()`, structured rejections mapped to 429/503 +
+`Retry-After`, and graceful drain; `tools/http_soak.py` is the
+open-loop chaos soak over real sockets.
+
 See docs/SERVING.md for the architecture and slot lifecycle.
 """
 from .sampling import filtered_logits, sample_tokens, slot_keys  # noqa: F401
 from .scheduler import (Request, SlotScheduler, RejectedError,  # noqa: F401
                         QueueFullError, ShedError, TenantQuota,
-                        TenantQuotaError)
+                        TenantQuotaError, TERMINAL_STATUSES)
 from .page_pool import PagePool, PagePoolExhausted  # noqa: F401
 from .prefix_cache import PrefixCache  # noqa: F401
 from .adapters import (AdapterPool, AdapterPoolExhausted,  # noqa: F401
@@ -68,10 +77,13 @@ from .policy import SheddingPolicy  # noqa: F401
 from .faults import FaultError, FaultPlan, ReplicaFaultPlan  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
 from .router import ServingRouter  # noqa: F401
+from .frontend import ServingFrontend, TokenStream  # noqa: F401
 
 __all__ = ["Request", "SlotScheduler", "RejectedError", "QueueFullError",
            "ShedError", "TenantQuota", "TenantQuotaError",
+           "TERMINAL_STATUSES",
            "ServingEngine", "ServingRouter",
+           "ServingFrontend", "TokenStream",
            "SheddingPolicy", "PagePool", "PagePoolExhausted",
            "AdapterPool", "AdapterPoolExhausted", "merged_weights",
            "random_lora",

@@ -1060,14 +1060,19 @@ class ServingEngine:
         """Abort a request by id, queued OR running. A queued request is
         simply dequeued; a running one releases its slot and its page
         leases immediately (tokens already emitted stay on the Request).
-        Returns the cancelled Request, or None when the id is unknown
-        (already finished, never submitted). Call from the serving
-        thread — cancellation mutates slot state between dispatches."""
+
+        Idempotent: returns the cancelled Request on success, or False
+        when the id is unknown to the scheduler — never submitted, or
+        already terminal (finished/cancelled/shed/failed). The
+        late-cancel leg of the disconnect vs natural-finish race is
+        therefore a no-op that records no second terminal timeline
+        event. Call from the serving thread — cancellation mutates slot
+        state between dispatches."""
         req = self.scheduler.cancel_queued(request_id)
         if req is None:
             slot = self.scheduler.slot_of(request_id)
             if slot is None:
-                return None
+                return False
             req = self._release_slot(slot)
         req.t_finish = self._clock()
         req.status = "cancelled"
@@ -1075,6 +1080,7 @@ class ServingEngine:
         telemetry.request_log.end(
             request_id, self._eid, "cancelled",
             tokens=len(req.output_tokens))
+        self._stream_close(req)
         self._set_load_gauges()
         self._set_pool_gauges()
         return req
@@ -1304,6 +1310,7 @@ class ServingEngine:
         telemetry.request_log.end(
             req.id, self._eid, "rejected", reason="deadline",
             queued=True, tokens=0)
+        self._stream_close(req)
         return req
 
     def _deadline_cancel(self, slot):
@@ -1317,6 +1324,7 @@ class ServingEngine:
         telemetry.request_log.end(
             req.id, self._eid, "finished", reason="deadline",
             tokens=len(req.output_tokens))
+        self._stream_close(req)
         self._set_pool_gauges()
         return req
 
@@ -1381,6 +1389,7 @@ class ServingEngine:
         telemetry.flight.record("quarantined", engine=self._eid,
                                 request=req.id,
                                 failures=req.dispatch_failures)
+        self._stream_close(req)
         return req
 
     def _requeue(self, req, now, blamed, error=""):
@@ -1942,6 +1951,7 @@ class ServingEngine:
         rl = telemetry.request_log
         finished = []
         bad = []
+        overflowed = []
         n_emitted = 0
         accepted = 0
         for slot in active_slots:
@@ -1969,6 +1979,7 @@ class ServingEngine:
                 first = int(toks[slot, 0])
                 req.output_tokens.append(first)
                 req.token_times.append(now)
+                streamed = self._stream_emit(req, [first])
                 req.dispatch_failures = 0
                 req.t_not_before = 0.0
                 req.status = "running"
@@ -2000,7 +2011,9 @@ class ServingEngine:
                 if spec:
                     self._hist[slot] = [int(t) for t in req.prompt] \
                         + [int(t) for t in req.output_tokens]
-                if self._done[slot] or self._remaining[slot] <= 0:
+                if not streamed:
+                    overflowed.append(slot)
+                elif self._done[slot] or self._remaining[slot] <= 0:
                     finished.append(self._finish(slot))
                 continue
             if not decode_mask[slot]:
@@ -2009,6 +2022,7 @@ class ServingEngine:
             emitted = [int(t) for t in toks[slot, :n]]
             req.output_tokens.extend(emitted)
             req.token_times.extend([now] * n)
+            streamed = self._stream_emit(req, emitted) if n else True
             # a clean dispatch clears the request's failure history —
             # probation is for consecutive faults, not per-lifetime
             req.dispatch_failures = 0
@@ -2029,8 +2043,12 @@ class ServingEngine:
             # tokens saw dt/n per token — the ACTUAL emitted count
             if n:
                 m["token_latency"].observe(dt / n, n)
-            if self._done[slot] or self._remaining[slot] <= 0:
+            if not streamed:
+                overflowed.append(slot)
+            elif self._done[slot] or self._remaining[slot] <= 0:
                 finished.append(self._finish(slot))
+        for slot in overflowed:
+            finished.append(self._overflow_cancel(slot))
         m["tokens_emitted"].inc(n_emitted)
         m["prefill_pending"].set(self._pending_tokens())
         if spec:
@@ -2050,6 +2068,54 @@ class ServingEngine:
             finished.extend(self._on_bad_slots(
                 bad, "non-finite logits in unified dispatch"))
         return finished
+
+    # -- per-request token streaming (serving/frontend.py subscribes) ------
+    def _stream_emit(self, req, tokens):
+        """Feed freshly emitted tokens to the request's subscriber
+        stream, if any (duck-typed: anything with emit(list) -> bool).
+        Returns False when the stream's bounded buffer could not absorb
+        them — the slow-client overflow signal. A raising subscriber is
+        treated the same way; it must never take the engine down."""
+        st = req.stream
+        if st is None:
+            return True
+        try:
+            return bool(st.emit(tokens))
+        except Exception:           # noqa: BLE001 — subscriber fault
+            return False
+
+    def _stream_close(self, req):
+        """Close the request's subscriber stream (if any) with its
+        terminal status, waking any reader blocked on it. Best-effort
+        and exception-proof for the same reason as _stream_emit."""
+        st = req.stream
+        if st is None:
+            return
+        try:
+            st.close(req.status)
+        except Exception:           # noqa: BLE001 — subscriber fault
+            pass
+
+    def _overflow_cancel(self, slot):
+        """Slow-client policy: the request's subscriber stream could
+        not absorb this dispatch's tokens (bounded buffer full).
+        Rather than queue tokens unboundedly on the host, cancel the
+        request — slot, page, and adapter leases released, terminal
+        `cancelled(stream_overflow)`. The stream closes with its
+        overflow flag set, so the front-end sends the client a
+        structured overflow error event instead of silently dropping
+        tokens."""
+        req = self._release_slot(slot)
+        req.status = "cancelled"
+        self._metrics["requests_cancelled"].inc()
+        telemetry.request_log.end(
+            req.id, self._eid, "cancelled", reason="stream_overflow",
+            tokens=len(req.output_tokens))
+        telemetry.flight.record("stream_overflow", engine=self._eid,
+                                request=req.id)
+        self._stream_close(req)
+        self._set_pool_gauges()
+        return req
 
     def _release_slot(self, slot):
         """Free a slot mid-flight or at completion: scheduler slot back
@@ -2091,5 +2157,6 @@ class ServingEngine:
         telemetry.request_log.end(
             req.id, self._eid, "finished", reason=reason,
             tokens=len(req.output_tokens))
+        self._stream_close(req)
         self._set_pool_gauges()
         return req

@@ -591,6 +591,15 @@ class ServingRouter:
                 telemetry.flight.note_shed(f"router{self._rid}")
                 self._owner.pop(req.id, None)
                 self._t_submit.pop(req.id, None)
+                if req.stream is not None:
+                    # export detached the request from its engine, so
+                    # no terminal transition will close the subscriber
+                    # stream — this is the end of the line, wake the
+                    # front-end reader
+                    try:
+                        req.stream.close("shed")
+                    except Exception:   # noqa: BLE001 — subscriber
+                        pass
                 self._pending.append(req)
 
     # -- hedging -----------------------------------------------------------
@@ -653,8 +662,12 @@ class ServingRouter:
                 return []            # clone shed/failed — primary runs on
             # the hedge WON: identical RNG streams mean its tokens are
             # exactly what the primary would have emitted — graft them,
-            # cancel the primary copy
+            # cancel the primary copy. The subscriber stream is
+            # detached first so the primary's cancel can't close it
+            # "cancelled"; the front-end reconciles the grafted token
+            # tail from the Request, then sees the "finished" close.
             pidx, orig = owner
+            st, orig.stream = orig.stream, None
             try:
                 self.replicas[pidx].engine.cancel(oid)
             except Exception:        # noqa: BLE001 — replica may be dead
@@ -662,6 +675,12 @@ class ServingRouter:
             orig.output_tokens = list(req.output_tokens)
             orig.status = "finished"
             orig.t_finish = req.t_finish
+            if st is not None:
+                orig.stream = st
+                try:
+                    st.close("finished")
+                except Exception:    # noqa: BLE001 — subscriber
+                    pass
             self._metrics["hedges_won"].inc()
             self._owner.pop(oid, None)
             self._note_done(orig)

@@ -35,7 +35,15 @@ import numpy as np
 from ..base import MXNetError
 
 __all__ = ["Request", "SlotScheduler", "TenantQuota", "RejectedError",
-           "QueueFullError", "TenantQuotaError", "ShedError"]
+           "QueueFullError", "TenantQuotaError", "ShedError",
+           "TERMINAL_STATUSES"]
+
+# The statuses a Request can END in. "exported" is NOT terminal — a
+# migrating request is between replicas and will be adopted (or shed)
+# by the router; front-ends and the idempotent-cancel check both key
+# off this set.
+TERMINAL_STATUSES = frozenset(
+    {"finished", "cancelled", "deadline", "failed", "shed"})
 
 _req_counter = itertools.count()
 _seq_counter = itertools.count()
@@ -109,6 +117,12 @@ class Request:
         self.dispatch_failures = 0
         self.t_not_before = 0.0
         self._seq = None             # global submit order, set by submit()
+        # subscriber slot (serving/frontend.py): anything with
+        # emit(tokens)->bool / close(status). The engine feeds it as
+        # tokens land and closes it at every terminal transition; it
+        # rides the Request through export/adopt migration, which is
+        # how a mid-stream failover re-attaches the live stream.
+        self.stream = None
 
     @property
     def prompt_len(self):

@@ -52,10 +52,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 __all__ = ["serve", "stop_server", "get_server", "IntrospectionServer",
+           "HttpServerThread",
            "register_status_provider", "unregister_status_provider",
            "collect_status", "set_degraded", "clear_degraded",
            "degraded_reasons", "register_ready_probe",
-           "unregister_ready_probe", "readiness", "component_ready"]
+           "unregister_ready_probe", "readiness", "component_ready",
+           "healthz_body", "readyz_body"]
 
 _T0 = time.time()
 _providers_lock = threading.Lock()
@@ -148,6 +150,34 @@ def component_ready(name):
     """One component's readiness (None when no such probe)."""
     st = readiness().get(str(name))
     return None if st is None else st["ready"]
+
+
+def healthz_body():
+    """The /healthz text body — shared by every HTTP surface (the
+    introspection server and serving/frontend.py): 'ok' when nothing
+    is flagged, else the degraded components and latched flight
+    reasons. Always 200 — this is liveness, not readiness."""
+    from . import flight
+    reasons = list(flight.latched_reasons())
+    reasons.extend(f"{n}={r}" for n, r
+                   in sorted(degraded_reasons().items()))
+    return "ok\n" if not reasons else \
+        "degraded: " + ",".join(reasons) + "\n"
+
+
+def readyz_body(component=None):
+    """The /readyz JSON body and status code — (dict, 200|503) —
+    shared by every HTTP surface. `component` scopes the answer to one
+    registered probe (503 when it is not ready or unknown)."""
+    comps = readiness()
+    if component is not None:
+        st = comps.get(component)
+        ready = bool(st and st["ready"])
+        body = {"component": component, "ready": ready, "state": st}
+    else:
+        ready = (not comps) or any(c["ready"] for c in comps.values())
+        body = {"ready": ready, "components": comps}
+    return body, (200 if ready else 503)
 
 
 def register_status_provider(name, fn):
@@ -297,27 +327,10 @@ class _Handler(BaseHTTPRequestHandler):
             if url.path in ("/", "/index.html"):
                 self._reply(_INDEX, "text/html; charset=utf-8")
             elif url.path == "/healthz":
-                from . import flight
-                reasons = list(flight.latched_reasons())
-                reasons.extend(f"{n}={r}" for n, r
-                               in sorted(degraded_reasons().items()))
-                body = "ok\n" if not reasons else \
-                    "degraded: " + ",".join(reasons) + "\n"
-                self._reply(body, "text/plain; charset=utf-8")
+                self._reply(healthz_body(), "text/plain; charset=utf-8")
             elif url.path == "/readyz":
-                comps = readiness()
-                which = q.get("component", [None])[0]
-                if which is not None:
-                    st = comps.get(which)
-                    ready = bool(st and st["ready"])
-                    body = {"component": which, "ready": ready,
-                            "state": st}
-                else:
-                    ready = (not comps) or any(
-                        c["ready"] for c in comps.values())
-                    body = {"ready": ready, "components": comps}
-                self._reply(json.dumps(body, sort_keys=True),
-                            code=200 if ready else 503)
+                body, code = readyz_body(q.get("component", [None])[0])
+                self._reply(json.dumps(body, sort_keys=True), code=code)
             elif url.path == "/metrics":
                 self._reply(render_prometheus(),
                             "text/plain; version=0.0.4; charset=utf-8")
@@ -349,32 +362,65 @@ class _Handler(BaseHTTPRequestHandler):
                 {"error": f"{type(e).__name__}: {e}"}), code=500)
 
 
-class IntrospectionServer:
-    """A ThreadingHTTPServer on a daemon thread. port=0 picks a free
-    port (read it back from `.port`); `stop()` shuts the listener down
-    and joins the thread."""
+class HttpServerThread:
+    """A ThreadingHTTPServer on a daemon thread — the shared lifecycle
+    for every HTTP surface in the package (this introspection server,
+    serving/frontend.py's ingress). port=0 picks a free port (read it
+    back from `.port`). `close()` is DETERMINISTIC and idempotent: it
+    stops the accept loop, releases the listening port, and joins the
+    server thread, so tests never leak listeners; `stop()` is an alias
+    and the instance is a context manager. Handlers reach the owning
+    wrapper through `self.server.owner` (set before the thread
+    starts, so the first request can never race it)."""
+
+    handler_class = None            # subclasses set the handler
+    name_prefix = "mx-http"
 
     def __init__(self, port=0, host="127.0.0.1"):
-        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd = ThreadingHTTPServer((host, int(port)),
+                                          self.handler_class)
         self._httpd.daemon_threads = True
+        self._httpd.owner = self
         self.host = host
         self.port = self._httpd.server_address[1]
+        self._closed = False
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
-            name=f"mx-telemetry-http:{self.port}", daemon=True)
+            name=f"{self.name_prefix}:{self.port}", daemon=True)
         self._thread.start()
 
     @property
     def url(self):
         return f"http://{self.host}:{self.port}"
 
-    def stop(self):
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)
 
+    def stop(self):
+        self.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
     def __repr__(self):
-        return f"IntrospectionServer({self.url})"
+        return f"{type(self).__name__}({self.url})"
+
+
+class IntrospectionServer(HttpServerThread):
+    """The telemetry surface on the shared HttpServerThread lifecycle
+    (see the module docstring for the endpoints)."""
+
+    handler_class = _Handler
+    name_prefix = "mx-telemetry-http"
 
 
 def serve(port=0, host="127.0.0.1"):

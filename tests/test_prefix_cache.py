@@ -394,7 +394,8 @@ def test_cancel_queued_request():
     eng.submit(drop)
     got = eng.cancel("drop")
     assert got is drop
-    assert eng.cancel("never-submitted") is None
+    assert eng.cancel("never-submitted") is False
+    assert eng.cancel("drop") is False      # idempotent double-cancel
     done = []
     while eng.has_work:
         done.extend(eng.step())

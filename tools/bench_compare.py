@@ -41,8 +41,9 @@ import sys
 __all__ = ["load_records", "compare", "main"]
 
 _LOWER_BETTER = ("latency", "_ms", "seconds", "bytes", "loss",
-                 "overhead", "ttft", "mismatch", "page_in", "eviction",
-                 "compiles", "shed", "pending", "makespan", "stall")
+                 "overhead", "ttft", "ttfb", "mismatch", "page_in",
+                 "eviction", "compiles", "shed", "pending", "makespan",
+                 "stall", "disconnect")
 
 
 def lower_is_better(name):

@@ -40,6 +40,8 @@ def register_everything():
     serving_engine._engine_metrics("catalog-check")
     from mxnet_tpu.serving import router as serving_router
     serving_router._router_metrics("catalog-check")
+    from mxnet_tpu.serving import frontend as serving_frontend
+    serving_frontend._frontend_metrics("catalog-check")
     telemetry.memory._gauges(telemetry.default_registry)
     telemetry.cost._metrics()                  # cost/compile family
     telemetry.ledger._gauges(telemetry.default_registry)

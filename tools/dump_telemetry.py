@@ -16,6 +16,7 @@ Usage:
     python tools/dump_telemetry.py --shed     # load-shedding headline
     python tools/dump_telemetry.py --tenants  # multi-tenant headline
     python tools/dump_telemetry.py --router   # multi-replica headline
+    python tools/dump_telemetry.py --http     # HTTP-ingress headline
 
 --trace writes the run's request timelines + spans as Chrome
 trace_event JSON (open in ui.perfetto.dev). --serve starts the live
@@ -151,6 +152,66 @@ def run_router():
     return router
 
 
+def run_http():
+    """A live ServingFrontend over a tiny engine: two clients stream
+    /v1/generate to completion and one hangs up mid-stream — so the
+    http_* instruments (requests by code, disconnects, TTFB, active
+    streams) carry real values in the dump."""
+    import http.client
+    import socket
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import GPT2Config, GPT2ForCausalLM
+    from mxnet_tpu.serving import ServingEngine, ServingFrontend
+
+    cfg = GPT2Config(vocab_size=97, units=32, num_layers=2, num_heads=2,
+                     max_length=64, dropout=0.0, attention_dropout=0.0)
+    net = GPT2ForCausalLM(cfg)
+    mx.rng.seed(0)
+    net.initialize(mx.init.Normal(0.05))
+    eng = ServingEngine(net, num_slots=2, max_length=32, page_size=8,
+                        decode_block=2, attn_impl="xla")
+    fe = ServingFrontend(eng, keepalive_s=0.05, step_idle_s=0.005)
+    try:
+        for i in range(2):          # well-behaved streaming clients
+            conn = http.client.HTTPConnection(fe.host, fe.port,
+                                              timeout=120)
+            conn.request("POST", "/v1/generate",
+                         json.dumps({"prompt": [3 + i, 5, 7],
+                                     "max_new_tokens": 5,
+                                     "request_id": f"http-{i}"}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200, resp.status
+            resp.read()
+            conn.close()
+        # one client that hangs up mid-stream -> disconnect + cancel
+        body = json.dumps({"prompt": [9, 8, 7], "max_new_tokens": 24,
+                           "request_id": "http-gone"}).encode()
+        sock = socket.create_connection((fe.host, fe.port), timeout=120)
+        sock.sendall(b"POST /v1/generate HTTP/1.0\r\n"
+                     b"Content-Type: application/json\r\n"
+                     b"Content-Length: " + str(len(body)).encode()
+                     + b"\r\n\r\n" + body)
+        buf = b""
+        while b"event: tokens" not in buf:
+            chunk = sock.recv(4096)
+            if not chunk:
+                break
+            buf += chunk
+        sock.close()
+        import time
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if not eng.has_work and fe.stats["active_streams"] == 0 \
+                    and fe.stats["disconnects"] >= 1:
+                break
+            time.sleep(0.02)
+    finally:
+        fe.close()
+    return fe
+
+
 def run_tenants():
     """A multi-tenant engine: more registered adapters than slab
     slots, three tenants with one pushed past its queue quota — so
@@ -243,6 +304,10 @@ def main():
                     help="also run a two-replica router with hedging "
                          "and a seeded mid-run replica kill and print "
                          "the multi-replica headline")
+    ap.add_argument("--http", action="store_true",
+                    help="also serve a tiny engine over a live HTTP "
+                         "frontend (streaming clients + one mid-stream "
+                         "hangup) and print the ingress headline")
     ap.add_argument("--serve", type=int, default=None, metavar="PORT",
                     help="start the live introspection server (0 = any "
                          "free port)")
@@ -260,7 +325,7 @@ def main():
               "(/metrics /statusz /requests /trace /healthz)")
     if args.spans:
         telemetry.enable_jsonl(args.spans)
-    eng = spec = shed_eng = router = tenant_eng = None
+    eng = spec = shed_eng = router = tenant_eng = frontend = None
     with telemetry.span("dump_telemetry.workloads"):
         if args.workload in ("serving", "both"):
             eng, spec = run_serving()
@@ -270,6 +335,8 @@ def main():
             tenant_eng = run_tenants()
         if args.router:
             router = run_router()
+        if args.http:
+            frontend = run_http()
         if args.workload in ("training", "both"):
             run_training()
     telemetry.memory.sample()
@@ -349,6 +416,20 @@ def main():
               f"(won {s['hedges_won']}, wasted {s['hedges_wasted']}), "
               f"replica-down {{{downs or 'none'}}}, "
               f"ready {s['replicas_ready']}/{s['replicas']} — {occ}")
+    if frontend is not None:
+        # the HTTP-ingress headline: the status-code ledger plus the
+        # robustness counters (disconnect->cancel, overflow-cancel)
+        s = frontend.stats
+        codes = ", ".join(f"{k}:{v}"
+                          for k, v in sorted(s["requests_by_code"].items()))
+        ttfb = telemetry.get("http_ttfb_seconds").labels(frontend._fid)
+        tail = (f"ttfb p99 {ttfb.percentile(99) * 1e3:.1f} ms"
+                if ttfb.count else "no TTFB samples")
+        print(f"# http: {{{codes or 'none'}}} by code, "
+              f"disconnects {s['disconnects']} "
+              f"(cancels issued {s['cancels_issued']}, "
+              f"noop {s['cancels_noop']}), "
+              f"overflows {s['stream_overflows']}, {tail}")
     if args.cost:
         # the /compilez + /memz headline, human-shaped: where every
         # dispatched program sits on the roofline and where HBM went

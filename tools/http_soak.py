@@ -1,0 +1,443 @@
+#!/usr/bin/env python
+"""Open-loop HTTP chaos soak for the serving frontend
+(docs/SERVING.md "HTTP front-end").
+
+A seeded Poisson stream of real-socket HTTP clients — well-behaved
+readers, mid-stream hangups, and slow readers that stall mid-stream —
+hits a ServingFrontend fronting a multi-replica ServingRouter while a
+ReplicaFaultPlan kills one replica mid-run. Open-loop means arrivals
+do NOT wait for completions, so backpressure is real: the admission
+queue fills and the 429/503 mapping gets exercised alongside the
+chaos.
+
+Pass criteria (exit 0 only if ALL hold):
+  * every admitted request reached exactly one terminal state — the
+    engines' finished+cancelled+failed counters reconcile with the
+    number of non-rejected submissions, and nothing is left queued,
+    active, or registered anywhere (zero lost requests);
+  * zero leaked resources: page audits, adapter audits, slot maps,
+    router owner map, and the frontend's live-stream table all clean;
+  * every fully-read greedy stream is bit-identical to the same
+    request served by an offline single engine; partially-read
+    streams (hangups, overflow) received a prefix of that reference;
+  * every 429/503 rejection carried a Retry-After header and the full
+    structured JSON body (type/reason/retry_after_s);
+  * disconnect accounting reconciles (cancels_issued + cancels_noop
+    == disconnects observed), and any overflow the frontend counted
+    reached its client as a structured `error` event;
+  * the scheduled replica kill fired and the fleet kept serving;
+  * steady_state_compiles == 0 on every replica after warmup — the
+    chaos (kills, migrations, cancels, overflows) must not retrace;
+  * graceful drain works: after begin_drain() a probe request gets
+    503 reason="draining" with Retry-After, then shutdown() drains
+    and releases the port.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/http_soak.py
+    python tools/http_soak.py --requests 96 --seed 3 --kill-after 8
+    python tools/http_soak.py --replicas 3 --rate 40 --kill-after 0
+"""
+import argparse
+import http.client
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _compiles(eid):
+    """Total compiles attributed to one engine's programs."""
+    from mxnet_tpu import telemetry
+    rep = telemetry.cost.report()["programs"]
+    return sum(s["compiles"] for p, s in rep.items()
+               if p.startswith(f"engine{eid}/"))
+
+
+def _sse_events(text):
+    """[(event, payload)] from a close-delimited SSE body."""
+    out = []
+    for block in text.split("\n\n"):
+        block = block.strip()
+        if not block or block.startswith(":"):
+            continue
+        ev, payload = None, None
+        for line in block.splitlines():
+            if line.startswith("event: "):
+                ev = line[len("event: "):]
+            elif line.startswith("data: "):
+                try:
+                    payload = json.loads(line[len("data: "):])
+                except ValueError:
+                    payload = None
+        if ev is not None:
+            out.append((ev, payload))
+    return out
+
+
+def _sse_tokens(events):
+    toks = []
+    for ev, p in events:
+        if ev == "tokens" and p:
+            toks.extend(p["tokens"])
+    return toks
+
+
+class _Client:
+    """One soak client: POSTs over a raw socket and reads according
+    to its seeded behavior. Records everything for the verdict."""
+
+    def __init__(self, idx, behavior, body, cutoff=None, stall_s=0.0):
+        self.idx = idx
+        self.behavior = behavior      # "read" | "hangup" | "slow"
+        self.body = body
+        self.cutoff = cutoff          # hangup: bytes to read first
+        self.stall_s = stall_s        # slow: stall after first tokens
+        self.status = None
+        self.headers = {}
+        self.raw = b""
+        self.error = None
+
+    def run(self, host, port):
+        try:
+            payload = json.dumps(self.body).encode()
+            sock = socket.create_connection((host, port), timeout=300)
+            try:
+                sock.sendall(
+                    b"POST /v1/generate HTTP/1.0\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Content-Length: " + str(len(payload)).encode()
+                    + b"\r\n\r\n" + payload)
+                stalled = False
+                while True:
+                    if self.behavior == "hangup" \
+                            and len(self.raw) >= self.cutoff:
+                        break         # hang up mid-stream, no goodbye
+                    chunk = sock.recv(4096)
+                    if not chunk:
+                        break
+                    self.raw += chunk
+                    if (self.behavior == "slow" and not stalled
+                            and b"event: tokens" in self.raw):
+                        # fall behind for real: the server keeps
+                        # generating into the bounded buffer and must
+                        # overflow-cancel rather than grow it
+                        stalled = True
+                        time.sleep(self.stall_s)
+            finally:
+                sock.close()
+        except Exception as e:        # noqa: BLE001 — verdict data
+            self.error = f"{type(e).__name__}: {e}"
+            return
+        head, _, rest = self.raw.partition(b"\r\n\r\n")
+        lines = head.decode(errors="replace").splitlines()
+        if lines and lines[0].startswith("HTTP/"):
+            try:
+                self.status = int(lines[0].split()[1])
+            except (IndexError, ValueError):
+                pass
+            for ln in lines[1:]:
+                k, _, v = ln.partition(":")
+                self.headers[k.strip().lower()] = v.strip()
+        self.raw = rest
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=48,
+                    help="number of open-loop clients")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="seeds arrivals, prompts, and chaos behavior")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="Poisson arrival rate (req/s) — deliberately "
+                         "above capacity so backpressure is real and "
+                         "the 429 path fires")
+    ap.add_argument("--kill-after", type=int, default=8, metavar="STEP",
+                    help="router step at which one seeded replica is "
+                         "killed (0 disables the kill)")
+    ap.add_argument("--stream-buffer", type=int, default=16,
+                    help="per-stream token buffer — small, so slow "
+                         "readers genuinely overflow")
+    ap.add_argument("--json", default=None,
+                    help="also write the summary JSON to this path")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import GPT2Config, GPT2ForCausalLM
+    from mxnet_tpu.serving import (ReplicaFaultPlan, Request,
+                                   ServingEngine, ServingFrontend,
+                                   ServingRouter)
+
+    cfg = GPT2Config(vocab_size=97, units=32, num_layers=2, num_heads=2,
+                     max_length=64, dropout=0.0, attention_dropout=0.0)
+    mx.rng.seed(3)
+    net = GPT2ForCausalLM(cfg)
+    net.initialize(mx.init.Normal(0.05))
+    max_len, page, slots, block = 64, 8, 2, 4
+    rng = np.random.default_rng(args.seed)
+
+    # seeded client behaviors: ~50% read everything, ~30% hang up at
+    # a seeded byte offset (0 = before the first event), ~20% are slow
+    # readers that stall mid-stream and advertise a tiny flow-control
+    # window (the keepalive/pacing chaos; at toy token counts the
+    # kernel socket buffers absorb the whole stream, so the overflow-
+    # cancel policy itself is pinned by tests/test_frontend.py)
+    behaviors = []
+    for i in range(args.requests):
+        u = rng.random()
+        behaviors.append("read" if u < 0.5
+                         else "hangup" if u < 0.8 else "slow")
+
+    # the request set: greedy, so every replica/batching/migration
+    # history must produce the SAME tokens as the offline reference
+    bodies, prompts = [], []
+    for i in range(args.requests):
+        prompt = rng.integers(1, cfg.vocab_size,
+                              int(rng.integers(3, 13))).tolist()
+        prompts.append(prompt)
+        body = {"prompt": prompt,
+                "max_new_tokens": int(rng.integers(6, 17)),
+                "request_id": f"soak-{i}"}
+        if behaviors[i] == "slow":
+            body["stream_buffer"] = 2       # < decode_block
+        bodies.append(body)
+
+    def new_engine(max_queue=None):
+        eng = ServingEngine(net, num_slots=slots, max_length=max_len,
+                            page_size=page, decode_block=block,
+                            attn_impl="xla", max_queue=max_queue)
+        # warm every prefill bucket a migrated request can land in
+        # (re-prefill covers prompt + already-emitted tokens)
+        eng.serve([Request(list(range(1, b + 1)), 2,
+                           request_id=f"warm{b}")
+                   for b in range(page, min(12 + 16 + page, max_len),
+                                  page)])
+        eng.mark_warm()
+        eng.reset_stats()
+        return eng
+
+    # offline reference: ONE fault-free engine serves clones of every
+    # request — the bit-identity bar for everything the soak streams
+    ref_eng = new_engine()
+    ref_reqs = [Request(p, b["max_new_tokens"], request_id=b["request_id"])
+                for p, b in zip(prompts, bodies)]
+    ref_eng.serve(ref_reqs)
+    reference = {r.id: [int(t) for t in r.output_tokens]
+                 for r in ref_reqs}
+    assert all(r.status == "finished" for r in ref_reqs)
+
+    engines = [new_engine(max_queue=4) for _ in range(args.replicas)]
+    compiles_at_warm = {e._eid: _compiles(e._eid) for e in engines}
+    router = ServingRouter(engines, hedge_after_s=1e9)
+    plan = None
+    if args.kill_after > 0:
+        victim = int(rng.integers(0, args.replicas))
+        plan = ReplicaFaultPlan(
+            kill={args.kill_after: victim}).install(router)
+
+    clients = []
+    for i, (beh, body) in enumerate(zip(behaviors, bodies)):
+        if beh == "read":
+            c = _Client(i, "read", body)
+        elif beh == "hangup":
+            c = _Client(i, "hangup", body,
+                        cutoff=int(rng.integers(0, 600)))
+        else:
+            c = _Client(i, "slow", body,
+                        stall_s=float(rng.uniform(1.0, 1.6)))
+        clients.append(c)
+    arrivals = np.cumsum(rng.exponential(1.0 / args.rate,
+                                         args.requests))
+
+    failures = []
+
+    def check(ok, msg):
+        if not ok:
+            failures.append(msg)
+
+    fe = ServingFrontend(router, stream_buffer=args.stream_buffer,
+                         keepalive_s=0.05, step_idle_s=0.005)
+    try:
+        threads = []
+        t0 = time.perf_counter()
+        for arr, c in zip(arrivals, clients):
+            lag = arr - (time.perf_counter() - t0)
+            if lag > 0:
+                time.sleep(lag)       # open-loop: fire on schedule
+            t = threading.Thread(target=c.run, args=(fe.host, fe.port),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=600)
+        check(not any(t.is_alive() for t in threads),
+              "client threads still alive after 600s")
+
+        # quiesce: the serving loop finishes whatever the hangups left
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if (not router.has_work
+                    and fe.stats["active_streams"] == 0
+                    and fe._cmd_q.empty()):
+                break
+            time.sleep(0.02)
+        soak_s = time.perf_counter() - t0
+
+        # -- graceful drain, while everything is still up ----------------
+        fe.begin_drain()
+        probe = _Client(-1, "read", {"prompt": [1, 2], "max_new_tokens": 2})
+        probe.run(fe.host, fe.port)
+        err = {}
+        try:
+            err = json.loads(probe.raw.decode())["error"]
+        except Exception:             # noqa: BLE001 — verdict below
+            pass
+        check(probe.status == 503 and err.get("reason") == "draining"
+              and int(probe.headers.get("retry-after", 0)) >= 1,
+              f"drain probe: status={probe.status}, error={err}, "
+              f"retry-after={probe.headers.get('retry-after')!r}")
+
+        # -- verdict ------------------------------------------------------
+        st = fe.stats
+        by_code = dict(st["requests_by_code"])
+        rejected = sum(int(v) for k, v in by_code.items()
+                       if k in ("400", "429", "500", "503"))
+        rejected -= 1                 # the drain probe's 503
+        admitted = args.requests - rejected
+        finished = sum(e.stats["requests_finished"] for e in engines)
+        cancelled = sum(e.stats["requests_cancelled"] for e in engines)
+        failed = sum(e.stats["requests_failed"] for e in engines)
+
+        check(finished + cancelled + failed == admitted,
+              f"terminal accounting: finished {finished} + cancelled "
+              f"{cancelled} + failed {failed} != admitted {admitted} "
+              f"(codes {by_code})")
+        check(failed == 0, f"requests_failed = {failed}")
+        check(not router.has_work, "router still has work after quiesce")
+        check(not router._owner, f"owner map leaked: {router._owner}")
+        check(st["active_streams"] == 0,
+              f"live streams leaked: {st['active_streams']}")
+        for e in engines:
+            check(e.scheduler.num_active == 0 and e.scheduler.num_queued
+                  == 0, f"engine{e._eid} slots/queue not empty")
+            check(e.audit_pages() == [],
+                  f"engine{e._eid} page audit: {e.audit_pages()}")
+            check(e.audit_adapters() == [],
+                  f"engine{e._eid} adapter audit: {e.audit_adapters()}")
+            drift = _compiles(e._eid) - compiles_at_warm[e._eid]
+            check(drift == 0,
+                  f"engine{e._eid} steady_state_compiles = {drift}")
+        check(st["cancels_issued"] + st["cancels_noop"]
+              == st["disconnects"],
+              f"cancel accounting: issued {st['cancels_issued']} + noop "
+              f"{st['cancels_noop']} != disconnects {st['disconnects']}")
+        if plan is not None:
+            check(plan.counts["kill"] == 1,
+                  f"scheduled kill never fired: {dict(plan.counts)}")
+            check(router.stats["replica_down"].get("kill") == 1,
+                  f"replica_down: {router.stats['replica_down']}")
+
+        # per-client verdicts against the offline reference
+        identical = prefix_ok = overflows_seen = reject_ok = 0
+        for c in clients:
+            check(c.error is None, f"client {c.idx}: {c.error}")
+            if c.error is not None or c.status is None:
+                continue
+            if c.status in (429, 503):
+                try:
+                    e = json.loads(c.raw.decode())["error"]
+                    good = (e.get("type") and e.get("reason")
+                            and "retry_after_s" in e)
+                except Exception:     # noqa: BLE001 — verdict
+                    good = False
+                good = good and int(c.headers.get("retry-after", 0)) >= 1
+                check(good, f"client {c.idx}: {c.status} rejection "
+                            f"missing Retry-After or structured body")
+                reject_ok += int(bool(good))
+                continue
+            if c.status != 200:
+                check(False, f"client {c.idx}: unexpected {c.status}")
+                continue
+            evs = _sse_events(c.raw.decode(errors="replace"))
+            got = _sse_tokens(evs)
+            ref = reference[f"soak-{c.idx}"]
+            if c.behavior == "read":
+                dones = [p for ev, p in evs if ev == "done"]
+                check(len(dones) == 1
+                      and dones[0]["status"] == "finished",
+                      f"client {c.idx}: full read did not finish: "
+                      f"{dones}")
+                check(got == ref,
+                      f"client {c.idx}: stream diverged from offline "
+                      f"reference ({got} != {ref})")
+                identical += int(got == ref)
+            else:
+                check(got == ref[:len(got)],
+                      f"client {c.idx}: partial stream is not a prefix "
+                      f"of the reference")
+                prefix_ok += int(got == ref[:len(got)])
+                overflows_seen += int(any(
+                    ev == "error" and p and p.get("error") == "overflow"
+                    for ev, p in evs))
+
+        # every overflow the frontend counted reached its client as a
+        # structured error event (only slow readers can overflow —
+        # everyone else's budget fits the buffer)
+        check(st["stream_overflows"] == overflows_seen,
+              f"overflow accounting: counted {st['stream_overflows']}, "
+              f"clients saw {overflows_seen} error events")
+
+        fe.shutdown(timeout=60)
+        check(not fe._loop_thread.is_alive(), "serving loop still alive")
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            s.bind((fe.host, fe.port))
+        except OSError:
+            check(False, "port not released after shutdown")
+        finally:
+            s.close()
+    finally:
+        if plan is not None:
+            plan.uninstall()
+        fe.close()
+
+    summary = {
+        "requests": args.requests,
+        "soak_seconds": round(soak_s, 3),
+        "requests_by_code": by_code,
+        "admitted": admitted,
+        "finished": finished,
+        "cancelled": cancelled,
+        "rejected": rejected,
+        "disconnects": st["disconnects"],
+        "stream_overflows": st["stream_overflows"],
+        "overflow_error_events": overflows_seen,
+        "full_streams_bit_identical": identical,
+        "partial_streams_prefix_ok": prefix_ok,
+        "rejections_with_retry_after": reject_ok,
+        "migrated": router.stats["migrated"],
+        "replica_down": router.stats["replica_down"],
+        "steady_state_compiles": {
+            f"engine{e._eid}": _compiles(e._eid) - compiles_at_warm[e._eid]
+            for e in engines},
+        "failures": failures,
+        "ok": not failures,
+    }
+    print(json.dumps(summary, indent=1, sort_keys=True))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+    for msg in failures:
+        print(f"FAIL: {msg}", file=sys.stderr)
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
