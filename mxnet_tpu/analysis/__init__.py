@@ -1,0 +1,29 @@
+"""graftlint: AST-based invariant checker for the mxnet_tpu repo.
+
+Four whole-program passes (stdlib `ast` only — no jax import needed):
+
+  * trace-safety     — no host-sync escapes inside jit-traced code
+  * thread-ownership — handler threads never reach @loop_only state
+  * resource         — every lease released on exception edges
+  * catalog          — metric names literal + documented
+
+plus the runtime annotation vocabulary (@loop_only / @thread_safe /
+@supervised and the MX_ASSERT_OWNERSHIP=1 assertion machinery) that
+the ownership pass reads and the serving stack wears.
+
+CLI: `python tools/graftlint.py` (docs/LINT.md).
+"""
+from .annotations import (OwnershipError, assertions_enabled,
+                          claim_ownership, disown, loop_only,
+                          set_assert_ownership, supervised, thread_safe)
+from .core import (SOURCE_ROOTS, BaselineError, Context, Finding,
+                   load_baseline, repo_root, run_passes,
+                   split_suppressed)
+
+__all__ = [
+    "loop_only", "thread_safe", "supervised", "OwnershipError",
+    "claim_ownership", "disown", "set_assert_ownership",
+    "assertions_enabled",
+    "Finding", "Context", "BaselineError", "load_baseline",
+    "split_suppressed", "run_passes", "SOURCE_ROOTS", "repo_root",
+]

@@ -1,0 +1,177 @@
+"""Thread-ownership annotations, checked statically AND dynamically.
+
+The serving stack's threading contract (docs/SERVING.md "Threading
+model") is one sentence: a single serving-loop thread owns every
+backend mutation; HTTP handler threads only parse, enqueue commands,
+and read snapshot state. These decorators write that sentence into the
+code where graftlint (mxnet_tpu/analysis, docs/LINT.md) can check it:
+
+  @loop_only     this method mutates loop-owned state — only the
+                 thread that owns the object may call it. The static
+                 ownership pass reports any call path from a handler-
+                 thread root into a @loop_only callee that doesn't go
+                 through a @thread_safe boundary.
+  @thread_safe   this function is safe to call from ANY thread (it
+                 only enqueues, or snapshots under its own lock). The
+                 static pass stops traversing here: the annotation is
+                 the audited boundary.
+  @supervised    this function takes pool leases (alloc/incref/
+                 acquire) WITHOUT a lexical try/finally because a
+                 named supervisor path audits and rolls back on fault.
+                 The justification string is mandatory — it names the
+                 rollback path for the reviewer and the resource pass.
+
+Dynamic side: set MX_ASSERT_OWNERSHIP=1 (or call
+set_assert_ownership(True)) and every @loop_only call asserts the
+calling thread matches the object's owner — first caller claims, and
+claim_ownership() re-claims explicitly (the serving loop does this at
+startup, cascading through engines, schedulers and pools). Off by
+default: @loop_only costs one module-global bool check per call, and
+@thread_safe/@supervised are free (attribute markers only).
+
+Stdlib-only on purpose: serving and telemetry import this module, so
+it must never pull in jax or numpy.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+
+__all__ = ["loop_only", "thread_safe", "supervised", "OwnershipError",
+           "set_assert_ownership", "assertions_enabled",
+           "claim_ownership", "disown"]
+
+_enabled = os.environ.get("MX_ASSERT_OWNERSHIP", "") in ("1", "true", "yes")
+
+# Fallback owner table for instances whose class uses __slots__ (no
+# instance __dict__ to hang _mx_owner_thread on). Keyed by id(); only
+# populated while assertions are on, for a handful of long-lived
+# engines/pools, so unbounded growth is not a concern in practice.
+_slot_owners = {}
+
+
+class OwnershipError(RuntimeError):
+    """A @loop_only method was called from a thread that does not own
+    the object (only raised when MX_ASSERT_OWNERSHIP is enabled)."""
+
+
+def set_assert_ownership(on):
+    """Enable/disable the runtime ownership assertion process-wide.
+    Returns the previous setting."""
+    global _enabled
+    prev, _enabled = _enabled, bool(on)
+    return prev
+
+
+def assertions_enabled():
+    return _enabled
+
+
+def _get_owner(obj):
+    try:
+        return obj.__dict__.get("_mx_owner_thread")
+    except AttributeError:
+        return _slot_owners.get(id(obj))
+
+
+def _set_owner(obj, ident):
+    try:
+        obj._mx_owner_thread = ident
+    except AttributeError:
+        _slot_owners[id(obj)] = ident
+
+
+# claim_ownership cascades through the attributes one serving object
+# owns on behalf of the loop, so re-claiming an engine (or a router, or
+# a whole frontend backend) re-claims everything its loop drives.
+_CASCADE_ATTRS = ("scheduler", "page_pool", "adapter_pool",
+                  "prefix_cache", "backend")
+
+
+def claim_ownership(obj, thread_ident=None):
+    """Declare the current thread (or `thread_ident`) the owner of
+    `obj` — and, cascading, of the components its loop drives: an
+    engine's scheduler/pools, a router's replica engines, a frontend's
+    backend. The serving loop calls this at startup so warm-up work
+    done on the constructing thread doesn't pin ownership there."""
+    ident = threading.get_ident() if thread_ident is None else thread_ident
+    seen = set()
+
+    def _claim(o):
+        if o is None or id(o) in seen:
+            return
+        seen.add(id(o))
+        _set_owner(o, ident)
+        for name in _CASCADE_ATTRS:
+            _claim(getattr(o, name, None))
+        for rep in getattr(o, "replicas", ()) or ():
+            _claim(getattr(rep, "engine", rep))
+
+    _claim(obj)
+
+
+def disown(obj):
+    """Drop `obj`'s ownership claim: the next @loop_only caller
+    re-claims (used when handing an object between threads)."""
+    try:
+        obj.__dict__.pop("_mx_owner_thread", None)
+    except AttributeError:
+        _slot_owners.pop(id(obj), None)
+
+
+def _assert_owner(obj, qualname):
+    ident = threading.get_ident()
+    owner = _get_owner(obj)
+    if owner is None:
+        _set_owner(obj, ident)       # first caller claims
+        return
+    if owner != ident:
+        me = threading.current_thread().name
+        raise OwnershipError(
+            f"{qualname} is @loop_only but was called from thread "
+            f"{me!r} (ident {ident}) while {type(obj).__name__} "
+            f"instance is owned by thread ident {owner}; handler "
+            f"threads must enqueue through a @thread_safe boundary "
+            f"(set MX_ASSERT_OWNERSHIP=0 to disable this check)")
+
+
+def loop_only(fn):
+    """Mark a method as callable only by the owning (serving-loop)
+    thread. Static contract always; runtime-asserted when
+    MX_ASSERT_OWNERSHIP=1."""
+    qualname = getattr(fn, "__qualname__", getattr(fn, "__name__", "?"))
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        if _enabled:
+            _assert_owner(self, qualname)
+        return fn(self, *args, **kwargs)
+
+    wrapper.__mx_ownership__ = "loop_only"
+    return wrapper
+
+
+def thread_safe(fn):
+    """Mark a function as safe to call from any thread. Zero runtime
+    cost — the marker is what the static ownership pass trusts, so
+    only apply it where the body genuinely just enqueues or snapshots
+    under its own lock."""
+    fn.__mx_ownership__ = "thread_safe"
+    return fn
+
+
+def supervised(justification):
+    """Mark a lease-taking function as covered by an audited
+    supervisor rollback path instead of a lexical try/finally. The
+    justification string is mandatory and should name the rollback
+    path (e.g. "rolled back by _on_admit_fault via step() audit")."""
+    if not isinstance(justification, str) or not justification.strip():
+        raise TypeError("@supervised requires a non-empty justification "
+                        "string naming the rollback path")
+
+    def mark(fn):
+        fn.__mx_supervised__ = justification
+        return fn
+
+    return mark

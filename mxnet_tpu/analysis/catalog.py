@@ -1,0 +1,221 @@
+"""Catalog pass: metric names are literals, and every one is
+documented.
+
+Static half: every instrument-creation site (`telemetry.counter(...)`,
+`telemetry.gauge(...)`, `telemetry.histogram(...)`, including local
+aliases like `c, g = telemetry.counter, telemetry.gauge`) must pass a
+**string literal** name — a name computed from runtime data defeats
+both this catalog check and the cardinality discipline (runtime values
+belong in label VALUES, never in metric names) — and every literal
+name must appear in docs/OBSERVABILITY.md (the same backtick
+extraction the dynamic check has always used). The telemetry framework
+itself (instruments.py and the telemetry/__init__ pass-through
+helpers) is exempt: those are the declaration plumbing, not creation
+sites.
+
+Dynamic half (`registry_findings`): the original
+tools/check_metrics_catalog.py walk, absorbed here so there is one
+source of truth — import every instrumented module, force the lazily
+declared families, then require every *registered* name to be
+documented. The tool is now a thin shim over this function; the static
+half additionally covers declaration sites the CPU-only dynamic walk
+can never reach.
+
+Rules: catalog-literal-name, catalog-undocumented (static);
+catalog-missing-doc (dynamic).
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import Finding, dotted, terminal_name
+
+__all__ = ["run", "registry_findings"]
+
+RULE_LITERAL = "catalog-literal-name"
+RULE_UNDOC = "catalog-undocumented"
+RULE_MISSING = "catalog-missing-doc"
+
+_KINDS = {"counter", "gauge", "histogram"}
+
+# receivers that denote the telemetry facade or a Registry
+_RECEIVERS = {"telemetry", "_telemetry", "tm", "default_registry",
+              "registry", "reg"}
+
+# framework plumbing: name flows through as a variable by design
+_EXEMPT = {
+    os.path.join("mxnet_tpu", "telemetry", "instruments.py"),
+    os.path.join("mxnet_tpu", "telemetry", "__init__.py"),
+}
+
+
+def _is_telemetry_receiver(node):
+    name = terminal_name(node)
+    if name in _RECEIVERS:
+        return True
+    d = dotted(node)
+    return d is not None and d.endswith(".telemetry")
+
+
+def _aliases(tree):
+    """{local name: kind} for `c = telemetry.counter` style bindings
+    (tuple assignments included) and `from ...telemetry import
+    counter` imports."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[-1] == "telemetry":
+                for a in node.names:
+                    if a.name in _KINDS:
+                        out[a.asname or a.name] = a.name
+            continue
+        if not isinstance(node, ast.Assign):
+            continue
+        targets = node.targets[0]
+        pairs = []
+        if isinstance(targets, ast.Name) \
+                and isinstance(node.value, ast.Attribute):
+            pairs = [(targets, node.value)]
+        elif isinstance(targets, ast.Tuple) \
+                and isinstance(node.value, ast.Tuple) \
+                and len(targets.elts) == len(node.value.elts):
+            pairs = list(zip(targets.elts, node.value.elts))
+        for t, v in pairs:
+            if isinstance(t, ast.Name) and isinstance(v, ast.Attribute) \
+                    and v.attr in _KINDS \
+                    and _is_telemetry_receiver(v.value):
+                out[t.id] = v.attr
+    return out
+
+
+def _creation_sites(tree):
+    """[(Call, kind)] instrument-creation calls in one module."""
+    aliases = _aliases(tree)
+    sites = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in _KINDS \
+                and _is_telemetry_receiver(func.value):
+            sites.append((node, func.attr))
+        elif isinstance(func, ast.Name) and func.id in aliases:
+            sites.append((node, aliases[func.id]))
+    return sites
+
+
+def _symbol_of(tree, call):
+    """Enclosing def/class qualname of a call (linear scan — catalog
+    sites are few)."""
+    best = []
+
+    def descend(node, stack):
+        for child in ast.iter_child_nodes(node):
+            s = stack
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                s = stack + [child.name]
+            if child is call or any(n is call for n in ast.walk(child)):
+                if child is call:
+                    best.append(list(stack))
+                    return
+                descend(child, s)
+                return
+
+    descend(tree, [])
+    return ".".join(best[0]) if best and best[0] else "<module>"
+
+
+def run(ctx):
+    findings = []
+    for path, tree in ctx.trees.items():
+        if path in _EXEMPT:
+            continue
+        for call, kind in _creation_sites(tree):
+            name_arg = call.args[0] if call.args else None
+            for kw in call.keywords:
+                if kw.arg == "name":
+                    name_arg = kw.value
+            if not (isinstance(name_arg, ast.Constant)
+                    and isinstance(name_arg.value, str)):
+                findings.append(Finding(
+                    RULE_LITERAL, path, call.lineno,
+                    _symbol_of(tree, call),
+                    f"{kind}() name must be a string literal at the "
+                    f"creation site (runtime data belongs in label "
+                    f"values, and the docs catalog check needs the "
+                    f"name statically)"))
+                continue
+            name = name_arg.value
+            if name not in ctx.doc_names:
+                findings.append(Finding(
+                    RULE_UNDOC, path, call.lineno,
+                    _symbol_of(tree, call),
+                    f"metric `{name}` is not documented in "
+                    f"docs/OBSERVABILITY.md — add it to the catalog "
+                    f"table"))
+    return findings
+
+
+# -- dynamic registry walk (the absorbed tools/check_metrics_catalog) ------
+
+def register_everything():
+    """Touch every declaration site so the live default registry holds
+    the full metric surface without running a workload. Requires jax
+    (JAX_PLATFORMS=cpu is forced) — callers that only need the static
+    pass never import this."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import mxnet_tpu  # noqa: F401  (module-level: jit caches)
+    from mxnet_tpu import telemetry
+    # module-level declarations ride on these imports
+    import mxnet_tpu.gluon.trainer    # noqa: F401
+    import mxnet_tpu.kvstore          # noqa: F401
+    import mxnet_tpu.parallel.comm    # noqa: F401
+    # lazily-declared families, forced explicitly:
+    from mxnet_tpu.serving import engine as serving_engine
+    serving_engine._engine_metrics("catalog-check")
+    from mxnet_tpu.serving import router as serving_router
+    serving_router._router_metrics("catalog-check")
+    from mxnet_tpu.serving import frontend as serving_frontend
+    serving_frontend._frontend_metrics("catalog-check")
+    telemetry.memory._gauges(telemetry.default_registry)
+    telemetry.cost._metrics()                  # cost/compile family
+    telemetry.ledger._gauges(telemetry.default_registry)
+    with telemetry.span("catalog_check"):      # span_duration_seconds
+        pass
+    telemetry.flight.install(out_dir="/tmp/mx-catalog-check")
+    telemetry.flight.uninstall()
+    return telemetry
+
+
+def registry_findings(doc_text=None):
+    """(findings, notes, n_registered): every registered metric must be
+    documented (findings); documented-but-unregistered names from the
+    catalog TABLE are returned as notes only — some instruments need a
+    TPU backend or a live workload to register."""
+    from .core import documented_names, repo_root
+    telemetry = register_everything()
+    if doc_text is None:
+        with open(os.path.join(repo_root(), "docs",
+                               "OBSERVABILITY.md")) as f:
+            doc_text = f.read()
+    documented = documented_names(doc_text)
+    registered = sorted(telemetry.default_registry._instruments)
+    findings = []
+    for n in registered:
+        if n not in documented:
+            inst = telemetry.default_registry.get(n)
+            findings.append(Finding(
+                RULE_MISSING, os.path.join("docs", "OBSERVABILITY.md"),
+                1, n,
+                f"registered metric `{n}` ({inst.kind}: {inst.help}) "
+                f"is missing from the docs catalog"))
+    import re
+    table_names = set()
+    for line in doc_text.splitlines():
+        m = re.match(r"^\| `([a-z][a-z0-9_]+)(?:\{[^}]*\})?` \|", line)
+        if m:
+            table_names.add(m.group(1))
+    notes = sorted(table_names - set(registered))
+    return findings, notes, len(registered)

@@ -1,0 +1,225 @@
+"""graftlint core: findings, baseline suppressions, and the repo walk.
+
+A Finding is (rule, path, line, symbol, message) — `symbol` is the
+enclosing def/class qualname, which is what the baseline matches on so
+suppressions survive line drift. The committed baseline
+(tools/graftlint_baseline.json) is the ONLY suppression mechanism and
+every entry must carry a written justification; an entry without one
+is itself an error (docs/LINT.md "Suppressions & the baseline").
+
+Passes are whole-program: a Context parses every source file once and
+each pass walks the shared ASTs (stdlib `ast` only — the linter must
+run anywhere, without jax).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+
+__all__ = ["Finding", "Context", "load_baseline", "BaselineError",
+           "run_passes", "SOURCE_ROOTS", "repo_root"]
+
+# What `python tools/graftlint.py` lints by default. tests/ is out:
+# fixtures under tests/data/lint_fixtures/ contain seeded violations,
+# and test code may legitimately poke at internals from odd threads.
+SOURCE_ROOTS = ("mxnet_tpu", "tools")
+
+_SKIP_DIRS = {"__pycache__", ".git", "build", "dist", ".eggs"}
+
+
+class Finding:
+    """One lint finding, carrying the invariant (rule) it violates."""
+
+    __slots__ = ("rule", "path", "line", "symbol", "message")
+
+    def __init__(self, rule, path, line, symbol, message):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.symbol = symbol or "<module>"
+        self.message = message
+
+    def key(self):
+        return (self.rule, self.path, self.symbol)
+
+    def to_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message}
+
+    def __repr__(self):
+        return (f"{self.path}:{self.line}: [{self.rule}] "
+                f"{self.message} (in {self.symbol})")
+
+
+def repo_root():
+    """The repository root (parent of the mxnet_tpu package)."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def _iter_py(root, rel):
+    top = os.path.join(root, rel)
+    if os.path.isfile(top) and top.endswith(".py"):
+        yield rel
+        return
+    for dirpath, dirnames, filenames in os.walk(top):
+        dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.relpath(os.path.join(dirpath, fn), root)
+
+
+class Context:
+    """Parsed view of the files under lint: {relpath: ast.Module},
+    raw sources, and the documented-metric-name set from
+    docs/OBSERVABILITY.md (for the catalog pass)."""
+
+    def __init__(self, root=None, paths=None, doc_text=None):
+        self.root = os.path.abspath(root or repo_root())
+        self.trees = {}
+        self.sources = {}
+        self.errors = []            # [(path, message)] — unparsable files
+        rels = []
+        for rel in (paths if paths is not None else SOURCE_ROOTS):
+            rel = os.path.relpath(os.path.abspath(
+                os.path.join(self.root, rel)), self.root)
+            rels.extend(_iter_py(self.root, rel))
+        for rel in rels:
+            if rel in self.trees:
+                continue
+            try:
+                with open(os.path.join(self.root, rel)) as f:
+                    src = f.read()
+                self.trees[rel] = ast.parse(src, filename=rel)
+                self.sources[rel] = src
+            except (OSError, SyntaxError) as e:
+                self.errors.append((rel, f"{type(e).__name__}: {e}"))
+        if doc_text is None:
+            doc = os.path.join(self.root, "docs", "OBSERVABILITY.md")
+            try:
+                with open(doc) as f:
+                    doc_text = f.read()
+            except OSError:
+                doc_text = ""
+        self.doc_names = documented_names(doc_text)
+
+
+def documented_names(doc_text):
+    """Metric names the docs catalog mentions — every backticked
+    `snake_case` token, with an optional {label} suffix (the same
+    extraction the dynamic registry check has always used)."""
+    return set(re.findall(r"`([a-z][a-z0-9_]+)(?:\{[^}]*\})?`",
+                          doc_text or ""))
+
+
+class BaselineError(ValueError):
+    """The baseline file itself is invalid (missing justification,
+    unknown keys, bad JSON shape)."""
+
+
+def load_baseline(path):
+    """Parse tools/graftlint_baseline.json into a list of suppression
+    dicts. Every entry MUST carry rule, path, symbol, and a non-empty
+    justification; symbol may be "*" to cover a whole file for one
+    rule. Raises BaselineError on any malformed entry — a suppression
+    nobody can explain is a finding, not a waiver."""
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    entries = data.get("suppressions")
+    if not isinstance(entries, list):
+        raise BaselineError(
+            f"{path}: expected a top-level {{\"suppressions\": [...]}}")
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict):
+            raise BaselineError(f"{path}: suppression #{i} is not an object")
+        for k in ("rule", "path", "symbol", "justification"):
+            if not isinstance(e.get(k), str) or not e[k].strip():
+                raise BaselineError(
+                    f"{path}: suppression #{i} needs a non-empty "
+                    f"{k!r} string (every accepted finding must be "
+                    f"justified in writing)")
+    return entries
+
+
+def split_suppressed(findings, baseline):
+    """(unsuppressed, suppressed) under the baseline entries."""
+    keep, hidden = [], []
+    for f in findings:
+        hit = any(e["rule"] == f.rule and e["path"] == f.path
+                  and e["symbol"] in ("*", f.symbol) for e in baseline)
+        (hidden if hit else keep).append(f)
+    return keep, hidden
+
+
+def run_passes(ctx, passes=None):
+    """Run the static passes over a Context; findings sorted by
+    (path, line). Unparsable files surface as `parse-error` findings
+    so a syntax error can never silently shrink coverage."""
+    from . import catalog, ownership, resources, trace_safety
+    if passes is None:
+        passes = (trace_safety.run, ownership.run, resources.run,
+                  catalog.run)
+    findings = [Finding("parse-error", path, 1, "<module>", msg)
+                for path, msg in ctx.errors]
+    for p in passes:
+        findings.extend(p(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# -- shared AST helpers used by more than one pass -------------------------
+
+def dotted(node):
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_name(node):
+    """The final identifier of a call target: `pc.release` -> 'release',
+    `release` -> 'release'."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def decorator_name(dec):
+    """The simple name of a decorator expression: `@loop_only`,
+    `@analysis.loop_only`, `@supervised("...")` all resolve to their
+    terminal identifier."""
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    return terminal_name(dec)
+
+
+class SymbolWalker(ast.NodeVisitor):
+    """Base visitor tracking the enclosing def/class qualname, so
+    findings can report a stable `symbol`."""
+
+    def __init__(self):
+        self._stack = []
+
+    @property
+    def symbol(self):
+        return ".".join(self._stack) or "<module>"
+
+    def _push(self, node):
+        self._stack.append(node.name)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _push
+    visit_AsyncFunctionDef = _push
+    visit_ClassDef = _push

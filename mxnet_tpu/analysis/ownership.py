@@ -1,0 +1,309 @@
+"""Thread-ownership pass: handler threads must not reach @loop_only.
+
+The serving threading contract (docs/SERVING.md "Threading model"):
+ONE serving-loop thread owns every backend mutation; HTTP handler
+threads (`do_GET`/`do_POST` in serving/frontend.py and
+telemetry/server.py) and watchdog threads (telemetry/flight.py) only
+parse, enqueue commands, and read snapshot state. The
+@loop_only/@thread_safe annotations (mxnet_tpu/analysis/annotations)
+write that contract onto methods; this pass builds a call graph over
+the repo and reports any path from a handler-thread root into a
+@loop_only callee that doesn't pass through a @thread_safe boundary
+(the command queue's enqueue functions).
+
+Roots are discovered structurally: every `do_GET`/`do_POST`/`do_HEAD`
+method (stdlib http.server dispatches those on a per-connection
+handler thread), plus any function installed as a
+`threading.Thread(target=..., name="...watchdog...")` target. Call
+edges resolve conservatively — `self.m()` within the class, bare
+names within the module, `obj.m()` to same-file defs first and to
+repo-wide defs only when the name is specific (not a stdlib-ish
+generic like .get/.put/.close and at most 3 candidates) — so the pass
+errs toward silence rather than noise; @loop_only on the callee is
+what makes a path reportable.
+
+A second rule flags calls into user-provided hooks made while holding
+a lock (`ownership-lock-held-hook`): a hook that blocks — or
+re-enters the instrument — deadlocks the serving path. The audited
+safe pattern (telemetry/tracing.py, request_trace.py) snapshots the
+hook list under the lock and fires AFTER releasing it; only calls
+lexically inside the `with <lock>:` block are flagged.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, decorator_name, terminal_name
+
+__all__ = ["run"]
+
+RULE_PATH = "ownership-handler-to-loop"
+RULE_LOCK_HOOK = "ownership-lock-held-hook"
+
+_HANDLER_METHODS = {"do_GET", "do_POST", "do_HEAD", "do_PUT",
+                    "do_DELETE"}
+
+# stdlib-ish method names too generic to resolve across files
+_GENERIC = {"get", "put", "set", "pop", "append", "extend", "clear",
+            "close", "join", "start", "wait", "acquire", "release",
+            "items", "keys", "values", "update", "read", "write",
+            "send", "recv", "add", "remove", "discard", "sort",
+            "copy", "index", "count", "run", "flush", "open"}
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+
+
+class _Def:
+    __slots__ = ("path", "cls", "name", "node", "ownership", "line")
+
+    def __init__(self, path, cls, name, node, ownership):
+        self.path = path
+        self.cls = cls
+        self.name = name
+        self.node = node
+        self.ownership = ownership
+        self.line = node.lineno
+
+    @property
+    def qualname(self):
+        local = f"{self.cls}.{self.name}" if self.cls else self.name
+        return f"{self.path}::{local}"
+
+    @property
+    def symbol(self):
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+def _ownership_of(node):
+    for dec in node.decorator_list:
+        name = decorator_name(dec)
+        if name in ("loop_only", "thread_safe"):
+            return name
+    return None
+
+
+def _index(ctx):
+    """Top-level functions and class methods per file (nested defs are
+    treated as part of their enclosing def's body)."""
+    defs = []
+    for path, tree in ctx.trees.items():
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                defs.append(_Def(path, None, node.name, node,
+                                 _ownership_of(node)))
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        defs.append(_Def(path, node.name, item.name,
+                                          item, _ownership_of(item)))
+    return defs
+
+
+def _receiver_name(func):
+    """Terminal name of a call receiver: `self.server.fe.cancel` ->
+    'fe'; `pc.release` -> 'pc'; bare name -> None."""
+    if isinstance(func, ast.Attribute):
+        return terminal_name(func.value)
+    return None
+
+
+def _is_lockish(name):
+    return name is not None and any(
+        k in name.lower() for k in ("lock", "cond", "sem", "mutex"))
+
+
+def _edges(d, by_name, same_file):
+    """Resolved callee _Defs for every call inside one def."""
+    out = []
+    for node in ast.walk(d.node):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Name):
+            for cand in same_file.get((d.path, func.id), ()):
+                if cand.cls is None:          # module-level function
+                    out.append(cand)
+            continue
+        if not isinstance(func, ast.Attribute):
+            continue
+        m = func.attr
+        recv = func.value
+        if isinstance(recv, ast.Name) and recv.id in ("self", "cls"):
+            cands = [c for c in same_file.get((d.path, m), ())
+                     if c.cls == d.cls]
+            if cands:
+                out.extend(cands)
+                continue
+        if _is_lockish(_receiver_name(func)):
+            continue
+        cands = list(same_file.get((d.path, m), ()))
+        if not cands and m not in _GENERIC:
+            cands = by_name.get(m, ())
+            if len(cands) > 3:
+                cands = ()
+        out.extend(cands)
+    return out
+
+
+def _thread_targets(d):
+    """Local method/function names installed as watchdog Thread
+    targets inside this def."""
+    names = []
+    for node in ast.walk(d.node):
+        if not isinstance(node, ast.Call) \
+                or terminal_name(node.func) != "Thread":
+            continue
+        target = tname = None
+        for kw in node.keywords:
+            if kw.arg == "target":
+                target = kw.value
+            elif kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                tname = str(kw.value.value)
+        if target is None or tname is None \
+                or "watchdog" not in tname.lower():
+            continue
+        names.append(terminal_name(target))
+    return names
+
+
+def _find_roots(defs, same_file):
+    roots = []
+    for d in defs:
+        if d.name in _HANDLER_METHODS:
+            roots.append(d)
+    for d in defs:
+        for tname in _thread_targets(d):
+            for cand in same_file.get((d.path, tname), ()):
+                if cand.cls == d.cls or cand.cls is None:
+                    roots.append(cand)
+    # dedupe, preserve order
+    seen, out = set(), []
+    for d in roots:
+        if id(d) not in seen:
+            seen.add(id(d))
+            out.append(d)
+    return out
+
+
+def _check_paths(ctx, defs):
+    by_name, same_file = {}, {}
+    for d in defs:
+        by_name.setdefault(d.name, []).append(d)
+        same_file.setdefault((d.path, d.name), []).append(d)
+    findings = []
+    for root in _find_roots(defs, same_file):
+        if root.ownership == "thread_safe":
+            continue
+        # BFS from the root; stop at @thread_safe boundaries
+        queue = [(root, (root,))]
+        seen = {id(root)}
+        while queue:
+            cur, path = queue.pop(0)
+            for nxt in _edges(cur, by_name, same_file):
+                if id(nxt) in seen:
+                    continue
+                seen.add(id(nxt))
+                if nxt.ownership == "thread_safe":
+                    continue
+                if nxt.ownership == "loop_only":
+                    chain = " -> ".join(p.symbol for p in path)
+                    findings.append(Finding(
+                        RULE_PATH, root.path, root.line, root.symbol,
+                        f"handler-thread root {root.symbol} reaches "
+                        f"@loop_only {nxt.qualname} via {chain} -> "
+                        f"{nxt.symbol} without a @thread_safe "
+                        f"boundary (enqueue through the command "
+                        f"queue instead)"))
+                    continue
+                queue.append((nxt, path + (nxt,)))
+    return findings
+
+
+# -- lock-held hook calls --------------------------------------------------
+
+def _lock_names(tree):
+    """Names assigned from threading.Lock()/RLock()/... in this file
+    (instance attrs and module globals), by terminal name."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if terminal_name(node.value.func) in _LOCK_FACTORIES:
+                for t in node.targets:
+                    n = terminal_name(t)
+                    if n:
+                        names.add(n)
+    return names
+
+
+def _hookish(name):
+    return name is not None and (
+        "hook" in name.lower() or "callback" in name.lower()
+        or name.endswith("_cb"))
+
+
+def _check_lock_held_hooks(ctx):
+    findings = []
+    for path, tree in ctx.trees.items():
+        locks = _lock_names(tree)
+
+        def lock_ctx(expr):
+            n = terminal_name(expr)
+            if isinstance(expr, ast.Call):      # e.g. with self._cv:
+                n = terminal_name(expr.func)
+            return n in locks or _is_lockish(n)
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.stack = []
+                self.hook_vars = []       # for-targets over hook lists
+
+            @property
+            def symbol(self):
+                return ".".join(self.stack) or "<module>"
+
+            def _named(self, node):
+                self.stack.append(node.name)
+                self.generic_visit(node)
+                self.stack.pop()
+
+            visit_FunctionDef = _named
+            visit_ClassDef = _named
+
+            def visit_With(self, node):
+                if any(lock_ctx(i.context_expr) for i in node.items):
+                    self._scan_locked(node.body, node)
+                self.generic_visit(node)
+
+            def _scan_locked(self, body, w):
+                hook_vars = set()
+                for sub in body:
+                    for node in ast.walk(sub):
+                        if isinstance(node, ast.For) \
+                                and _hookish(terminal_name(node.iter)):
+                            t = terminal_name(node.target)
+                            if t:
+                                hook_vars.add(t)
+                        if not isinstance(node, ast.Call):
+                            continue
+                        fname = terminal_name(node.func)
+                        called_var = (isinstance(node.func, ast.Name)
+                                      and node.func.id in hook_vars)
+                        if _hookish(fname) or called_var:
+                            findings.append(Finding(
+                                RULE_LOCK_HOOK, path, node.lineno,
+                                self.symbol,
+                                f"user-provided hook `{fname}` is "
+                                f"invoked while holding a lock — a "
+                                f"blocking or re-entrant hook "
+                                f"deadlocks this path (snapshot the "
+                                f"hook list under the lock, call "
+                                f"after releasing it)"))
+
+        V().visit(tree)
+    return findings
+
+
+def run(ctx):
+    defs = _index(ctx)
+    return _check_paths(ctx, defs) + _check_lock_held_hooks(ctx)

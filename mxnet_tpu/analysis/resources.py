@@ -1,0 +1,215 @@
+"""Resource-discipline pass: every lease is released on exception
+edges.
+
+The page pool, prefix cache and adapter pool are refcounted
+(serving/page_pool.py, adapters.py): `alloc`/`incref`/`acquire` take a
+lease that MUST be returned by `decref`/`free`/`release`/`evict` on
+every exit path, or pages leak until an audit() catches the drift —
+the class of lease-leak bug PR 7 fixed by hand. This pass checks the
+post-dominance property statically at every acquire-vocabulary call
+site: the call must be covered by
+
+  * a lexically enclosing try with a `finally` that performs a
+    release-vocabulary call, or
+  * an enclosing try whose exception handler performs a release call
+    and re-raises (the engine's _map_slot_pages pattern), or
+  * an enclosing function annotated `@supervised("<justification>")`,
+    naming the audited supervisor rollback path that owns cleanup
+    (the engine's _admit -> _on_admit_fault pattern), or
+  * immediate transfer of ownership to the caller
+    (`return pool.alloc(n)`).
+
+Pool internals are exempt: a call on `self`-owned state inside a
+class that itself defines a release-vocabulary method (PagePool,
+AdapterPool, PrefixCache) is the primitive's implementation, audited
+by its own `audit()`. Lock `.acquire()` is excluded by receiver name.
+
+Rule: resource-release-on-error.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, decorator_name, terminal_name
+
+__all__ = ["run"]
+
+RULE = "resource-release-on-error"
+
+ACQUIRE_OPS = {"alloc", "incref", "acquire"}
+RELEASE_OPS = {"decref", "free", "release", "evict"}
+
+
+def _is_lockish(name):
+    return name is not None and any(
+        k in name.lower() for k in ("lock", "cond", "sem", "mutex"))
+
+
+def _has_release_call(nodes):
+    for stmt in nodes:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) \
+                    and terminal_name(node.func) in RELEASE_OPS:
+                return True
+    return False
+
+
+def _handler_releases_and_reraises(handler):
+    """An except block that releases AND re-raises post-dominates the
+    exception edge with a release."""
+    reraises = any(isinstance(n, ast.Raise)
+                   for n in ast.walk(handler))
+    return reraises and _has_release_call(handler.body)
+
+
+class _Site:
+    __slots__ = ("call", "op", "fn_stack", "try_stack", "stmt_stack")
+
+    def __init__(self, call, op, fn_stack, try_stack, stmt_stack):
+        self.call = call
+        self.op = op
+        self.fn_stack = list(fn_stack)
+        self.try_stack = list(try_stack)
+        self.stmt_stack = list(stmt_stack)
+
+
+class _Collector(ast.NodeVisitor):
+    """Finds acquire-vocabulary call sites with their lexical context
+    (enclosing functions/classes, enclosing trys, enclosing stmt)."""
+
+    def __init__(self):
+        self.sites = []
+        self.fn_stack = []        # (kind, node) kind in {'class','fn'}
+        self.try_stack = []       # (Try, section) section in {'body',...}
+        self.stmt_stack = []
+
+    def visit_ClassDef(self, node):
+        self.fn_stack.append(("class", node))
+        self.generic_visit(node)
+        self.fn_stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self.fn_stack.append(("fn", node))
+        self.generic_visit(node)
+        self.fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Try(self, node):
+        for section, stmts in (("body", node.body),
+                               ("orelse", node.orelse),
+                               ("finalbody", node.finalbody)):
+            self.try_stack.append((node, section))
+            for s in stmts:
+                self.visit(s)
+            self.try_stack.pop()
+        for h in node.handlers:
+            self.try_stack.append((node, "handler"))
+            for s in h.body:
+                self.visit(s)
+            self.try_stack.pop()
+
+    def generic_visit(self, node):
+        is_stmt = isinstance(node, ast.stmt)
+        if is_stmt:
+            self.stmt_stack.append(node)
+        if isinstance(node, ast.Call):
+            op = terminal_name(node.func)
+            if op in ACQUIRE_OPS and isinstance(node.func, ast.Attribute):
+                recv = node.func.value
+                recv_name = terminal_name(recv)
+                if not _is_lockish(recv_name):
+                    self.sites.append(_Site(
+                        node, op, self.fn_stack, self.try_stack,
+                        self.stmt_stack))
+        super().generic_visit(node)
+        if is_stmt:
+            self.stmt_stack.pop()
+
+
+def _enclosing_class(site):
+    for kind, node in reversed(site.fn_stack):
+        if kind == "class":
+            return node
+    return None
+
+
+def _enclosing_fn(site):
+    for kind, node in reversed(site.fn_stack):
+        if kind == "fn":
+            return node
+    return None
+
+
+def _class_defines_release(cls):
+    return any(isinstance(n, ast.FunctionDef) and n.name in RELEASE_OPS
+               for n in cls.body)
+
+
+def _receiver_is_self_owned(call):
+    """True for self.alloc(...) / self.pool.incref(...) — state the
+    enclosing class owns."""
+    node = call.func.value
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id in ("self", "cls")
+
+
+def _supervision(fn):
+    for dec in fn.decorator_list:
+        if decorator_name(dec) == "supervised":
+            return True
+    return False
+
+
+def _covered_by_try(site):
+    for trynode, section in site.try_stack:
+        if section != "body":
+            continue
+        if trynode.finalbody and _has_release_call(trynode.finalbody):
+            return True
+        if any(_handler_releases_and_reraises(h)
+               for h in trynode.handlers):
+            return True
+    return False
+
+
+def _is_returned(site):
+    """`return pool.alloc(n)` (possibly wrapped in a simple
+    expression): ownership transfers to the caller."""
+    for stmt in reversed(site.stmt_stack):
+        if isinstance(stmt, ast.Return):
+            return True
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+    return False
+
+
+def run(ctx):
+    findings = []
+    for path, tree in ctx.trees.items():
+        col = _Collector()
+        col.visit(tree)
+        for site in col.sites:
+            cls = _enclosing_class(site)
+            if cls is not None and _class_defines_release(cls) \
+                    and _receiver_is_self_owned(site.call):
+                continue              # pool internals, audited there
+            fn = _enclosing_fn(site)
+            if fn is not None and _supervision(fn):
+                continue
+            if _covered_by_try(site):
+                continue
+            if _is_returned(site):
+                continue
+            symbol = fn.name if fn is not None else "<module>"
+            if cls is not None and fn is not None:
+                symbol = f"{cls.name}.{fn.name}"
+            findings.append(Finding(
+                RULE, path, site.call.lineno, symbol,
+                f"`.{site.op}()` lease is not released on exception "
+                f"edges: wrap in try/finally (or try/except that "
+                f"releases and re-raises), or annotate the function "
+                f"@supervised(\"<rollback path>\") if an audited "
+                f"supervisor owns cleanup"))
+    return findings

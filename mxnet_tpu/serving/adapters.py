@@ -49,6 +49,7 @@ import functools
 import numpy as np
 
 from ..base import MXNetError
+from ..analysis import loop_only, thread_safe
 
 __all__ = ["AdapterPool", "AdapterPoolExhausted", "random_lora",
            "merged_weights"]
@@ -234,6 +235,7 @@ class AdapterPool:
         self.evictions += 1
         return victim
 
+    @loop_only
     def acquire(self, adapter_id):
         """Pin ``adapter_id`` for the lifetime of one active request and
         return its slab slot (paging it in on a miss).  None/0 is the
@@ -256,6 +258,7 @@ class AdapterPool:
         self._last_used[slot] = self._tick
         return slot
 
+    @loop_only
     def release(self, adapter_id):
         """Drop one pin.  The adapter stays resident (warm) until LRU
         eviction needs its slot."""
@@ -270,6 +273,7 @@ class AdapterPool:
                              f"(slot {slot})")
         self._pins[slot] -= 1
 
+    @loop_only
     def evict(self, adapter_id):
         """Explicitly drop a resident adapter from the slab (refused
         while pinned).  The slab data is left in place — slot reuse
@@ -286,6 +290,7 @@ class AdapterPool:
         self.evictions += 1
         return True
 
+    @thread_safe
     def audit(self, assignments=None, raise_on_error=False):
         """O(slots) invariant check — the supervisor runs this after
         every caught dispatch fault (next to ``PagePool.audit``) and

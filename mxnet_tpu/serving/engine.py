@@ -82,6 +82,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import telemetry
+from ..analysis import loop_only, supervised, thread_safe
 from ..telemetry import cost as _cost
 from ..telemetry import ledger as _ledger
 from ..base import MXNetError
@@ -978,6 +979,7 @@ class ServingEngine:
         return {"warmed": self._steady, "degraded": self._degraded,
                 "draining": self._draining}
 
+    @thread_safe
     def drain(self):
         """Begin a rolling-restart drain: new submit() rejects with
         ShedError(reason="draining", retry_after_s=<drain estimate>),
@@ -990,6 +992,7 @@ class ServingEngine:
         self._draining = True
         telemetry.flight.record("draining", engine=self._eid)
 
+    @thread_safe
     def undrain(self):
         """Reopen admission after a drain (no-op when not draining)."""
         if not self._draining:
@@ -998,6 +1001,7 @@ class ServingEngine:
         telemetry.flight.record("undrained", engine=self._eid)
 
     # -- public API --------------------------------------------------------
+    @loop_only
     def submit(self, request):
         """Queue a Request (validated against this engine's capacity).
         Rejections — over-long prompt, full admission queue, policy
@@ -1056,6 +1060,7 @@ class ServingEngine:
         self._metrics["queue_depth"].set(self.scheduler.num_queued)
         return out
 
+    @loop_only
     def cancel(self, request_id):
         """Abort a request by id, queued OR running. A queued request is
         simply dequeued; a running one releases its slot and its page
@@ -1086,6 +1091,7 @@ class ServingEngine:
         return req
 
     # -- migration seams (serving/router.py failover + drain) --------------
+    @loop_only
     def adopt(self, request, migrated_from=None):
         """Queue a request EXPORTED from another replica, preserving
         its emitted tokens: admission re-prefills prompt+emitted and
@@ -1125,6 +1131,7 @@ class ServingEngine:
         self._metrics["queue_depth"].set(self.scheduler.num_queued)
         return request
 
+    @loop_only
     def export_requests(self):
         """Remove and return EVERY queued and in-flight request
         (original submit order), releasing slots and page leases. The
@@ -1165,6 +1172,7 @@ class ServingEngine:
     def has_work(self):
         return self.scheduler.has_work
 
+    @loop_only
     def step(self):
         """One SUPERVISED scheduling round: shed queued work past its
         deadline, cancel running work past its deadline, admit free
@@ -1217,6 +1225,7 @@ class ServingEngine:
             self._set_load_gauges()
         return finished
 
+    @loop_only
     def serve(self, requests=()):
         """Submit `requests`, run until the queue and all slots drain,
         and return every TERMINAL request (submission order) —
@@ -1329,6 +1338,7 @@ class ServingEngine:
         return req
 
     # -- fault supervision --------------------------------------------------
+    @thread_safe
     def audit_pages(self, raise_on_error=False):
         """Page-pool invariant audit with this engine's full lease map:
         every mapped slot's table row, any extra lease rows registered
@@ -1344,6 +1354,7 @@ class ServingEngine:
         return self.page_pool.audit(leases=leases, members=members,
                                     raise_on_error=raise_on_error)
 
+    @thread_safe
     def audit_adapters(self, raise_on_error=False):
         """Adapter-pool invariant audit with this engine's slot
         assignments: every active slot's pinned adapter must be
@@ -1625,6 +1636,9 @@ class ServingEngine:
         self._mapped[slot] = False
 
     # -- admission ---------------------------------------------------------
+    @supervised("adapter/page leases taken here are rolled back by "
+                "_on_admit_fault (slot state parked, leases released, "
+                "pool audited) when any later admission step raises")
     def _admit(self, slot, req):
         """Map pages and park the prompt as this slot's chunk queue —
         NO forward runs here. The unified dispatch streams the queue

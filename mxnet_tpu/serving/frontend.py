@@ -56,6 +56,7 @@ from http.server import BaseHTTPRequestHandler
 from urllib.parse import parse_qs, urlparse
 
 from ..base import MXNetError
+from ..analysis import assertions_enabled, claim_ownership, thread_safe
 from .. import telemetry
 from ..telemetry import server as _tserver
 from .scheduler import (Request, RejectedError, QueueFullError,
@@ -510,6 +511,7 @@ class ServingFrontend:
     def draining(self):
         return self._draining
 
+    @thread_safe
     def begin_drain(self):
         """Stop accepting new requests: /v1/generate answers 503 with
         a drain-estimate Retry-After and the registered /readyz probe
@@ -574,6 +576,10 @@ class ServingFrontend:
 
     # -- serving loop: the ONLY thread that touches the backend ------------
     def _serving_loop(self):
+        if assertions_enabled():
+            # warm-up ran on the constructing thread; this loop owns
+            # the backend (and everything its cascade drives) from here
+            claim_ownership(self._backend)
         try:
             while not self._stop_evt.is_set():
                 self._drain_cmds()
@@ -648,6 +654,7 @@ class ServingFrontend:
                        int(body.get("max_new_tokens", 16)),
                        request_id=rid, **kw)
 
+    @thread_safe
     def _submit_via_loop(self, req):
         """Hand the request to the serving thread and wait for the
         admission verdict: ("ok"|"rejected"|"invalid"|"error", exc)."""
@@ -658,12 +665,14 @@ class ServingFrontend:
             return "error", MXNetError("submission timed out")
         return box.outcome, box.error
 
+    @thread_safe
     def cancel(self, request_id):
         """Route a cancel onto the serving thread (handler threads and
         external callers must never call the backend directly)."""
         self._cmd_q.put(("cancel", request_id))
         self._wake.set()
 
+    @thread_safe
     def _on_disconnect(self, req):
         self._metrics["disconnects"].inc()
         with self._lock:
@@ -715,6 +724,7 @@ class ServingFrontend:
         return max(waits) if waits else None
 
     # -- observability -----------------------------------------------------
+    @thread_safe
     def _ready_probe(self):
         return {"warmed": True, "degraded": False,
                 "draining": self._draining or self._closed}
@@ -732,5 +742,6 @@ class ServingFrontend:
                 "draining": self._draining,
             }
 
+    @thread_safe
     def _statusz(self):
         return {"url": self.url, "stats": self.stats}

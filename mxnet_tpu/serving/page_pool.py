@@ -36,6 +36,7 @@ from collections import deque
 import numpy as np
 
 from ..base import MXNetError
+from ..analysis import loop_only, thread_safe
 
 __all__ = ["PagePool", "PagePoolExhausted"]
 
@@ -93,6 +94,7 @@ class PagePool:
                 state = "allocated" if allocated else "free"
                 raise MXNetError(f"page {p} is not {state}")
 
+    @loop_only
     def alloc(self, n):
         """Take `n` free pages (refcount 1 each). Raises when the pool
         cannot satisfy the request — the caller (prefix cache) evicts
@@ -109,6 +111,7 @@ class PagePool:
         self._allocated[pages] = True
         return pages
 
+    @loop_only
     def incref(self, pages):
         """Add one lease per page (pages must be live)."""
         pages = list(pages)
@@ -120,6 +123,7 @@ class PagePool:
         np.add.at(self._refcount, pages, 1)
         return pages
 
+    @loop_only
     def adopt(self, pages):
         """Add one lease per page where refcount may be 0 (the prefix
         cache re-leasing an idle cached page on a match)."""
@@ -128,6 +132,7 @@ class PagePool:
         np.add.at(self._refcount, pages, 1)
         return pages
 
+    @loop_only
     def decref(self, pages):
         """Drop one lease per page; returns the pages that reached zero
         (still allocated — pass them to free() to recycle)."""
@@ -139,6 +144,7 @@ class PagePool:
         np.subtract.at(self._refcount, pages, 1)
         return [p for p in pages if self._refcount[p] == 0]
 
+    @loop_only
     def free(self, pages):
         """Return zero-ref pages to the free list."""
         pages = list(pages)
@@ -152,6 +158,7 @@ class PagePool:
             self._free.append(p)
         return pages
 
+    @loop_only
     def cow(self, page):
         """Copy-on-write split: given a page the caller wants to WRITE,
         return (dst_page, needs_copy). Exclusive pages come straight
@@ -165,6 +172,7 @@ class PagePool:
         self.decref([page])
         return dst, True
 
+    @thread_safe
     def audit(self, leases=None, members=(), raise_on_error=False):
         """O(pages) invariant check — the supervisor runs this after
         every caught dispatch fault, and tests run it at drain.

@@ -58,6 +58,7 @@ import numpy as np
 
 from .. import telemetry
 from ..base import MXNetError
+from ..analysis import loop_only
 from ..telemetry import server as _tserver
 from .scheduler import (QueueFullError, RejectedError, Request,
                         ShedError)
@@ -376,6 +377,7 @@ class ServingRouter:
                   priority=request.priority)
 
     # -- public API --------------------------------------------------------
+    @loop_only
     def submit(self, request):
         """Place one request: prefix-affinity target first (load-aware
         spill and pre-screening may reorder), remaining routable
@@ -408,6 +410,7 @@ class ServingRouter:
             return request
         self._reject_all(request, fails)
 
+    @loop_only
     def cancel(self, request_id):
         """Cancel a routed request (and any hedge duplicate of it)
         wherever it lives. Returns the Request, or None."""
@@ -435,6 +438,7 @@ class ServingRouter:
             rep.state == "up" and rep.engine.has_work
             for rep in self.replicas)
 
+    @loop_only
     def step(self):
         """One fleet scheduling round: fire the chaos tick, step every
         up replica (its exceptions mean the REPLICA died — requests
@@ -473,6 +477,7 @@ class ServingRouter:
         self._set_gauges()
         return out
 
+    @loop_only
     def serve(self, requests=()):
         """Submit `requests` (router-rejected ones come back with
         status "shed"), run the fleet until it drains, and return
@@ -488,6 +493,7 @@ class ServingRouter:
         done.sort(key=lambda r: (r.t_submit is None, r.t_submit))
         return done
 
+    @loop_only
     def drain(self, replica, migrate=False):
         """Begin a rolling restart of one replica: admission closes
         (new submits route around it; direct submits shed with
@@ -502,6 +508,7 @@ class ServingRouter:
             self._migrate(moved, from_eid=rep.engine._eid)
         self._set_gauges()
 
+    @loop_only
     def rejoin(self, replica):
         """Return a drained (or previously failed) replica to the
         rotation: admission reopens and the watchdog re-arms. The

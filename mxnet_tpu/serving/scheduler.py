@@ -33,6 +33,7 @@ from collections import deque
 import numpy as np
 
 from ..base import MXNetError
+from ..analysis import loop_only, thread_safe
 
 __all__ = ["Request", "SlotScheduler", "TenantQuota", "RejectedError",
            "QueueFullError", "TenantQuotaError", "ShedError",
@@ -286,6 +287,7 @@ class SlotScheduler:
         return q.weight if q is not None else 1.0
 
     # -- queue -------------------------------------------------------------
+    @loop_only
     def submit(self, request):
         pr = min(max(int(getattr(request, "priority", 1)), 0),
                  self.num_priorities - 1)
@@ -313,6 +315,7 @@ class SlotScheduler:
         self._queues[pr].append(request)
         return request
 
+    @loop_only
     def requeue(self, request):
         """Put a request the engine rolled back (faulted dispatch,
         transient allocation failure) back at the FRONT of its class —
@@ -321,6 +324,7 @@ class SlotScheduler:
         self._queues[request.priority].appendleft(request)
         return request
 
+    @loop_only
     def pop_expired(self, now):
         """Remove and return every queued request whose deadline has
         passed — the engine sheds these before admission."""
@@ -391,6 +395,7 @@ class SlotScheduler:
             return req
         return None
 
+    @loop_only
     def admit(self, now=None):
         """Pair queued requests with free slots: highest priority class
         first, FIFO within a class, with the aging and probation rules
@@ -412,6 +417,7 @@ class SlotScheduler:
             admitted.append((slot, req))
         return admitted
 
+    @loop_only
     def release(self, slot):
         """Free a slot whose request finished (or was evicted)."""
         if slot not in self._active:
@@ -420,6 +426,7 @@ class SlotScheduler:
         self._free.append(slot)
         return req
 
+    @loop_only
     def cancel_queued(self, request_id):
         """Remove a not-yet-admitted request from its queue by id.
         Returns the Request, or None when no queued request matches

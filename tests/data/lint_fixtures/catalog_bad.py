@@ -1,0 +1,11 @@
+"""Seeded catalog violations: a runtime-formatted metric name and an
+undocumented literal one. Parsed only, never imported."""
+from mxnet_tpu import telemetry
+
+
+def make_metrics(name):
+    c = telemetry.counter
+    dynamic = telemetry.counter(f"requests_{name}_total",
+                                "name baked from runtime data")
+    undoc = c("totally_undocumented_metric_total", "not in the docs")
+    return dynamic, undoc

@@ -1,0 +1,13 @@
+"""Near-misses the catalog pass must NOT flag: a documented literal
+instrument, numpy's histogram (not an instrument), and a .counter on
+a non-telemetry object. Parsed only, never imported."""
+import numpy as np
+
+from mxnet_tpu import telemetry
+
+
+def make_metrics(values, stats):
+    documented = telemetry.counter("documented_metric_total", "ok")
+    hist, edges = np.histogram(values)      # numpy, not an instrument
+    other = stats.counter(values)           # unrelated receiver
+    return documented, hist, edges, other

@@ -1,0 +1,32 @@
+"""Seeded ownership violations: a handler-thread path straight into a
+@loop_only method, and a hook fired while holding a lock. Parsed
+only, never imported."""
+import threading
+
+from mxnet_tpu.analysis import loop_only
+
+
+class Engine:
+    @loop_only
+    def submit(self, req):
+        self.q = req
+
+
+class Handler:
+    def do_GET(self):
+        self.helper()
+
+    def helper(self):
+        # handler thread mutating loop-owned state directly
+        self.server.engine.submit(None)
+
+
+class BadLog:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hooks = []
+
+    def fire(self, event):
+        with self._lock:
+            for hook in self._hooks:
+                hook(event)             # hook invoked under the lock

@@ -1,0 +1,40 @@
+"""Near-misses the ownership pass must NOT flag: the handler path
+stops at a @thread_safe enqueue boundary, and the hook list is
+snapshotted under the lock but fired after release. Parsed only."""
+import threading
+
+from mxnet_tpu.analysis import loop_only, thread_safe
+
+
+class Engine:
+    @loop_only
+    def submit(self, req):
+        self.q = req
+
+
+class Frontend:
+    @thread_safe
+    def enqueue(self, req):
+        self.cmd_q.append(("submit", req))
+
+    def drain_cmds(self):
+        # loop thread only — not reachable from a handler root
+        for _, req in self.cmd_q:
+            self.engine.submit(req)
+
+
+class Handler:
+    def do_POST(self):
+        self.server.fe.enqueue(None)    # boundary: traversal stops
+
+
+class GoodLog:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hooks = []
+
+    def fire(self, event):
+        with self._lock:
+            hooks = list(self._hooks)
+        for hook in hooks:
+            hook(event)                 # after release — safe

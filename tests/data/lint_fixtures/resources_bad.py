@@ -1,0 +1,12 @@
+"""Seeded resource-discipline violation: a lease taken with no
+release on the exception edge. Parsed only, never imported."""
+
+
+class Worker:
+    def __init__(self, pool):
+        self.pool = pool
+
+    def grab(self, n):
+        pages = self.pool.alloc(n)      # leaks if prepare() raises
+        self.meta = prepare(pages)      # noqa: F821 — fixture
+        return pages

@@ -1,0 +1,54 @@
+"""Near-misses the resource pass must NOT flag: try/finally coverage,
+except-release-reraise, @supervised rollback, return-transfer, pool
+internals, and plain lock acquire. Parsed only, never imported."""
+import threading
+
+from mxnet_tpu.analysis import supervised
+
+
+class Pool:
+    def alloc(self, n):
+        self.used = self.used + n       # internals of the primitive
+        return list(range(n))
+
+    def release(self, pages):
+        self.used = self.used - len(pages)
+
+
+class Careful:
+    def __init__(self, pool):
+        self.pool = pool
+        self._lock = threading.Lock()
+
+    def grab_covered(self, n):
+        pages = None
+        try:
+            pages = self.pool.alloc(n)
+            return consume(pages)       # noqa: F821 — fixture
+        finally:
+            if pages is not None:
+                self.pool.release(pages)
+
+    def grab_reraise(self, n):
+        try:
+            leased = self.pool.alloc(n)
+            return consume(leased)      # noqa: F821 — fixture
+        except Exception:
+            self.pool.release(locals().get("leased", []))
+            raise
+
+    def grab_transfer(self, n):
+        return self.pool.alloc(n)       # ownership moves to the caller
+
+    @supervised("rolled back by the supervisor audit (fixture)")
+    def grab_supervised(self, n):
+        pages = self.pool.alloc(n)
+        self.meta = len(pages)
+        return pages
+
+    def locked(self):
+        self._lock.acquire()            # a lock, not a lease
+        try:
+            return self.pool.used
+        finally:
+            self._lock.release()

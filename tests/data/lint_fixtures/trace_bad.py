@@ -1,0 +1,13 @@
+"""Seeded trace-safety violations — every rule fires exactly where
+tests/test_lint.py expects. NOT importable serving code; parsed only."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def leaky_step(x, y):
+    if x > 0:                           # trace-host-branch: traced `if`
+        y = y + 1
+    scale = float(jnp.sum(y))           # trace-host-sync: float() syncs
+    key = f"bucket-{x}"                 # trace-format: value in a key
+    return y * scale, key

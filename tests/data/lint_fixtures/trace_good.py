@@ -1,0 +1,25 @@
+"""Near-misses the trace-safety pass must NOT flag: static args,
+shape reads, container truthiness, isinstance, unpacked helper
+results. Parsed only, never imported."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def stable_step(x, n, *rest):
+    if n > 2:                           # static_argnums arg: host value
+        x = x * 2.0
+    if x.ndim == 2:                     # shape/ndim reads are static
+        x = x.sum(axis=-1)
+    extras = tuple(rest)
+    if extras:                          # container truthiness = length
+        x = x + extras[0]
+    if not extras:
+        x = x - 1.0
+    out = x if isinstance(x, jnp.ndarray) else jnp.asarray(x)
+    leaves, treedef = jax.tree_util.tree_flatten(out)
+    width = len(leaves)                 # host list from unpacked call
+    label = f"rank-{x.ndim}"            # static attr in an f-string
+    return out * width, label

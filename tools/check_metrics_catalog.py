@@ -1,12 +1,14 @@
 #!/usr/bin/env python
 """Fail when a registered metric is missing from the docs catalog.
 
-Imports every instrumented module (and forces the lazily-declared
-instrument families — per-engine serving children, memory gauges, span
-histogram, flight counters) so the live default registry holds the full
-metric surface, then checks each registered name appears in
-docs/OBSERVABILITY.md. Run under JAX_PLATFORMS=cpu; tier-1 runs it as a
-test (tests/test_introspection.py), so the catalog can never rot.
+Thin shim over graftlint's catalog pass (mxnet_tpu/analysis/catalog.py
+`registry_findings`), kept for its stable CLI contract — tier-1 runs
+it as a subprocess (tests/test_introspection.py) and scripts grep its
+"OK:"/"FAIL:" lines. The registry walk itself (import every
+instrumented module, force the lazily-declared families, diff against
+docs/OBSERVABILITY.md) now lives in the analysis package, where
+`python tools/graftlint.py --registry` runs the same check alongside
+the static catalog rules.
 
 Exit 0: every registered metric is documented. Exit 1: the missing
 names are listed. Documented-but-unregistered names are a warning only
@@ -16,69 +18,24 @@ Usage:
     JAX_PLATFORMS=cpu python tools/check_metrics_catalog.py
 """
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-DOC = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "docs", "OBSERVABILITY.md")
-
-
-def register_everything():
-    """Touch every declaration site so the registry is fully populated
-    without running a workload."""
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    import mxnet_tpu  # noqa: F401  (module-level: jit caches)
-    from mxnet_tpu import telemetry
-    # module-level declarations ride on these imports
-    import mxnet_tpu.gluon.trainer    # noqa: F401
-    import mxnet_tpu.kvstore          # noqa: F401
-    import mxnet_tpu.parallel.comm    # noqa: F401
-    # lazily-declared families, forced explicitly:
-    from mxnet_tpu.serving import engine as serving_engine
-    serving_engine._engine_metrics("catalog-check")
-    from mxnet_tpu.serving import router as serving_router
-    serving_router._router_metrics("catalog-check")
-    from mxnet_tpu.serving import frontend as serving_frontend
-    serving_frontend._frontend_metrics("catalog-check")
-    telemetry.memory._gauges(telemetry.default_registry)
-    telemetry.cost._metrics()                  # cost/compile family
-    telemetry.ledger._gauges(telemetry.default_registry)
-    with telemetry.span("catalog_check"):      # span_duration_seconds
-        pass
-    telemetry.flight.install(out_dir="/tmp/mx-catalog-check")
-    telemetry.flight.uninstall()
-    return telemetry
-
 
 def main():
-    telemetry = register_everything()
-    with open(DOC) as f:
-        doc = f.read()
-    documented = set(re.findall(r"`([a-z][a-z0-9_]+)(?:\{[^}]*\})?`", doc))
-    registered = sorted(telemetry.default_registry._instruments)
-    missing = [n for n in registered if n not in documented]
-    if missing:
+    from mxnet_tpu.analysis.catalog import registry_findings
+    findings, notes, n_registered = registry_findings()
+    if findings:
         print("FAIL: registered metrics missing from the "
               "docs/OBSERVABILITY.md catalog:")
-        for n in missing:
-            inst = telemetry.default_registry.get(n)
-            print(f"  {n} ({inst.kind}): {inst.help}")
+        for f in findings:
+            print(f"  {f.message}")
         return 1
-    # reverse direction: warn only (TPU-only / workload-only names).
-    # Parsed from the catalog TABLE rows, so prose mentions of name
-    # prefixes (`serving_`, trigger reasons, ...) don't false-positive.
-    table_names = set()
-    for line in doc.splitlines():
-        m = re.match(r"^\| `([a-z][a-z0-9_]+)(?:\{[^}]*\})?` \|", line)
-        if m:
-            table_names.add(m.group(1))
-    unregistered = sorted(table_names - set(registered))
-    if unregistered:
+    if notes:
         print("note: documented but not registered on this platform "
-              f"(ok): {', '.join(unregistered)}")
-    print(f"OK: {len(registered)} registered metrics all documented")
+              f"(ok): {', '.join(notes)}")
+    print(f"OK: {n_registered} registered metrics all documented")
     return 0
 
 

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""graftlint — AST-based invariant checker for this repo.
+
+Runs four whole-program static passes (trace-safety, thread-ownership,
+resource discipline, metrics catalog) over mxnet_tpu/ and tools/, then
+subtracts the committed baseline (tools/graftlint_baseline.json).
+Nonzero exit on any unsuppressed finding, so it can gate CI; the
+tier-1 test tests/test_lint.py runs exactly this.
+
+  python tools/graftlint.py                # human-readable, exit 0/1
+  python tools/graftlint.py --json         # machine-readable findings
+  python tools/graftlint.py --registry     # also run the dynamic
+                                           # metrics-registry check
+                                           # (imports jax; CPU forced)
+  python tools/graftlint.py path/a.py ...  # lint specific files/dirs
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 configuration error
+(bad baseline — e.g. a suppression without a justification).
+
+See docs/LINT.md for the invariants and the suppression policy.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu.analysis import core  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: %s)"
+                         % " ".join(core.SOURCE_ROOTS))
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON on stdout")
+    ap.add_argument("--baseline",
+                    default=os.path.join("tools",
+                                         "graftlint_baseline.json"),
+                    help="suppression file, relative to the repo root "
+                         "(default: %(default)s)")
+    ap.add_argument("--registry", action="store_true",
+                    help="also run the dynamic metrics-registry check "
+                         "(imports mxnet_tpu; needs jax, CPU forced)")
+    args = ap.parse_args(argv)
+
+    root = core.repo_root()
+    try:
+        baseline = core.load_baseline(os.path.join(root, args.baseline))
+    except (core.BaselineError, ValueError) as e:
+        print(f"graftlint: baseline error: {e}", file=sys.stderr)
+        return 2
+
+    ctx = core.Context(root=root, paths=args.paths or None)
+    findings = core.run_passes(ctx)
+
+    notes = []
+    if args.registry:
+        from mxnet_tpu.analysis import catalog
+        reg_findings, reg_notes, n = catalog.registry_findings()
+        findings.extend(reg_findings)
+        notes.append(f"registry: {n} registered metrics checked")
+        notes.extend(f"note: documented but not registered here: `{m}` "
+                     f"(may need a TPU backend or a live workload)"
+                     for m in reg_notes)
+
+    unsuppressed, suppressed = core.split_suppressed(findings, baseline)
+
+    if args.as_json:
+        json.dump({
+            "findings": [f.to_dict() for f in unsuppressed],
+            "suppressed": [f.to_dict() for f in suppressed],
+            "files_checked": len(ctx.trees),
+        }, sys.stdout, indent=2)
+        print()
+    else:
+        for f in unsuppressed:
+            print(repr(f))
+        for line in notes:
+            print(line)
+        if unsuppressed:
+            print(f"graftlint: {len(unsuppressed)} finding(s) "
+                  f"({len(suppressed)} baseline-suppressed, "
+                  f"{len(ctx.trees)} files)")
+        else:
+            print(f"graftlint: OK — {len(ctx.trees)} files clean "
+                  f"({len(suppressed)} baseline-suppressed)")
+    return 1 if unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

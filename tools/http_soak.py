@@ -166,6 +166,14 @@ def main(argv=None):
                     help="also write the summary JSON to this path")
     args = ap.parse_args(argv)
 
+    # the soak is exactly the workload the ownership assertions exist
+    # for: HTTP handler threads racing a serving loop under chaos.
+    # Enable them unless the caller explicitly disabled them.
+    os.environ.setdefault("MX_ASSERT_OWNERSHIP", "1")
+    from mxnet_tpu.analysis import set_assert_ownership
+    set_assert_ownership(
+        os.environ["MX_ASSERT_OWNERSHIP"] in ("1", "true", "yes"))
+
     import numpy as np
 
     import mxnet_tpu as mx
