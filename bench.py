@@ -1740,6 +1740,211 @@ def bench_gpt2_serving_chunked():
     return 0 if ok else 1
 
 
+def bench_gpt2_serving_quantkv():
+    """Int8 KV pages vs fp32 at ONE fixed HBM budget — the capacity
+    proof (docs/SERVING.md "Quantized KV pages"). The budget is sized
+    so the fp32 engine is page-limited (half its natural pool): the
+    byte-denominated `PagePool.from_bytes` sizing then hands the int8
+    engine >= 1.8x (really ~2x here, pool-clamped; ~3.9x per byte) the
+    ADMITTED pages, i.e. more concurrent slots, at identical W and
+    zero steady-state compiles. Both engines serve the same Poisson
+    stream (greedy + sampled mix); accuracy is gated two ways: a
+    greedy tolerance oracle (per-token agreement vs the fp32 engine —
+    int8 rounding may flip near-tie argmaxes, so agreement, not
+    equality) and a paired-seed frequency test (first sampled token
+    over many seeds; total-variation distance between the fp32 and
+    int8 empirical marginals). Pass criteria: admitted-pages ratio
+    >= 1.8, int8 goodput >= 0.9x fp32 at the same budget, greedy
+    token agreement >= 0.6, frequency TV <= 0.30, zero steady
+    compiles, clean audits, everything finished. vs_baseline is the
+    int8/fp32 goodput ratio (>1 = the freed bytes bought throughput)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import GPT2ForCausalLM, gpt2_774m_config
+    from mxnet_tpu.serving import Request, ServingEngine
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8 if on_tpu else 4))
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS",
+                                    32 if on_tpu else 20))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", 0))  # req/s; 0=open
+    n_freq = int(os.environ.get("BENCH_QUANTKV_FREQ_SEEDS", 200))
+    cfg = gpt2_774m_config(dtype="bfloat16" if on_tpu else "float32",
+                           dropout=0.0, attention_dropout=0.0)
+    max_len, page = 1024, 64
+    p_lo, p_hi, o_lo, o_hi = 16, 128, 32, 96
+    if not on_tpu:  # CPU smoke config
+        cfg.vocab_size, cfg.units, cfg.hidden_size = 512, 256, 1024
+        cfg.num_layers, cfg.num_heads, cfg.max_length = 2, 4, 128
+        max_len, page = 128, 8
+        p_lo, p_hi, o_lo, o_hi = 2, 12, 4, 12
+
+    net = GPT2ForCausalLM(cfg)
+    net.initialize(mx.init.Normal(0.02))
+    if on_tpu:
+        net.cast("bfloat16")
+
+    # ONE byte budget for both engines, sized so fp32 is page-limited:
+    # half its natural pool (but never below one slot's worth of pages)
+    L, H = cfg.num_layers, cfg.num_heads
+    Dh = cfg.units // cfg.num_heads
+    fp_page_bytes = 2 * L * page * H * Dh * 4
+    pages_per_slot = max_len // page
+    budget = fp_page_bytes * max(pages_per_slot,
+                                 slots * pages_per_slot // 2)
+
+    def mk_requests(n, id0):
+        rng = np.random.default_rng(17)
+        out = []
+        for i in range(n):
+            out.append(Request(
+                rng.integers(0, cfg.vocab_size,
+                             int(rng.integers(p_lo, p_hi + 1))).tolist(),
+                int(rng.integers(o_lo, o_hi + 1)),
+                do_sample=bool(i % 2), temperature=0.8, top_k=40,
+                seed=i, request_id=id0 + i))
+        return out
+
+    def run_config(tag, kv_dtype):
+        # int8 numerics depend on the chunk grid, so BOTH configs pin
+        # the same grid with a non-binding prefill budget — the
+        # comparison varies storage dtype and nothing else
+        eng = ServingEngine(net, num_slots=slots, max_length=max_len,
+                            page_size=page, kv_dtype=kv_dtype,
+                            hbm_budget_bytes=budget,
+                            chunk_tokens=page,
+                            prefill_chunk_budget=slots * page)
+        eng.serve([Request(list(range(1, page + 1)), 2,
+                           request_id=f"{tag}-warm-greedy")])
+        eng.serve([Request(list(range(1, page + 1)), 2, do_sample=True,
+                           seed=0, request_id=f"{tag}-warm-sampled")])
+        eng.mark_warm()
+        c0 = _engine_compiles(eng._eid)
+        eng.reset_stats()
+
+        reqs = mk_requests(n_requests, id0=1000)
+        rng = np.random.default_rng(13)
+        gaps = rng.exponential(1.0 / rate, n_requests) if rate > 0 \
+            else np.zeros(n_requests)
+        arrivals = np.cumsum(gaps)
+        t0 = time.perf_counter()
+        pending = list(zip(arrivals, reqs))
+        while pending or eng.has_work:
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                eng.submit(pending.pop(0)[1])
+            if eng.has_work:
+                eng.step()
+            elif pending:
+                time.sleep(min(pending[0][0] - now, 0.01))
+        dt = time.perf_counter() - t0
+
+        fin = [r for r in reqs if r.status == "finished"]
+        tokens = sum(len(r.output_tokens) for r in fin)
+        s = eng.stats
+        return eng, {
+            "kv_dtype": s["kv_quant_enabled"] and "int8" or "float32",
+            "admitted_pages": eng.page_pool.num_pages,
+            "kv_page_bytes": s["kv_page_bytes"],
+            "kv_bytes_per_token": s["kv_bytes_per_token"],
+            "admission_capacity": s["admission_capacity"],
+            "goodput_tokens_per_sec": round(tokens / dt, 2),
+            "makespan_s": round(dt, 3),
+            "finished": len(fin), "requests": n_requests,
+            "steady_state_compiles": _engine_compiles(eng._eid) - c0,
+            "warm_compiles": c0,
+            "audit_leaks": len(eng.audit_pages()),
+            "outputs": {r.id: (bool(r.do_sample), list(r.output_tokens))
+                        for r in reqs},
+            "device_cost": _device_cost_extras(eng._eid),
+        }
+
+    fp_eng, fp = run_config("fp32", None)
+    q8_eng, q8 = run_config("int8", "int8")
+
+    # greedy tolerance oracle: per-token agreement on greedy requests
+    out_f, out_q = fp.pop("outputs"), q8.pop("outputs")
+    agree = total = exact = n_greedy = 0
+    for rid, (sampled, toks_f) in out_f.items():
+        if sampled:
+            continue
+        toks_q = out_q[rid][1]
+        n_greedy += 1
+        exact += int(toks_f == toks_q)
+        agree += sum(int(a == b) for a, b in zip(toks_f, toks_q))
+        total += max(len(toks_f), len(toks_q))
+    agreement = agree / total if total else 0.0
+
+    # paired-seed frequency test: same uniform draws through both
+    # engines, so the empirical first-token marginals only separate
+    # where a draw lands between the two CDFs
+    freq_prompt = list(range(3, 3 + max(3, p_lo)))
+    counts = {}
+    for tag, eng in (("fp", fp_eng), ("q8", q8_eng)):
+        c = {}
+        for s in range(n_freq):
+            r = Request(freq_prompt, 1, do_sample=True, temperature=1.0,
+                        top_k=8, seed=s, request_id=f"freq-{tag}-{s}")
+            eng.serve([r])
+            t = r.output_tokens[0]
+            c[t] = c.get(t, 0) + 1
+        counts[tag] = c
+    support = set(counts["fp"]) | set(counts["q8"])
+    tv = 0.5 * sum(abs(counts["fp"].get(t, 0) - counts["q8"].get(t, 0))
+                   for t in support) / n_freq
+
+    # the frequency serves ran through the already-warm engines: the
+    # steady-compile and audit verdicts cover them too
+    for eng, blk in ((fp_eng, fp), (q8_eng, q8)):
+        blk["steady_state_compiles"] = \
+            _engine_compiles(eng._eid) - blk.pop("warm_compiles")
+        blk["audit_leaks"] = len(eng.audit_pages())
+    pages_ratio = round(q8["admitted_pages"] / fp["admitted_pages"], 3)
+    goodput_ratio = round(q8["goodput_tokens_per_sec"]
+                          / max(fp["goodput_tokens_per_sec"], 1e-9), 3)
+    extras = {
+        "hbm_budget_bytes": budget,
+        "capacity_at_bytes": {"admitted_pages": pages_ratio},
+        "admitted_pages_ratio": pages_ratio,
+        "greedy_token_agreement": round(agreement, 4),
+        "greedy_exact_sequences": f"{exact}/{n_greedy}",
+        "frequency_tv_distance": round(tv, 4),
+        "frequency_seeds": n_freq,
+        "int8": q8, "float32": fp,
+        "slots": slots,
+        "prompt_lens": f"U[{p_lo},{p_hi}]",
+        "output_lens": f"U[{o_lo},{o_hi}]",
+        "arrivals": "open-loop" if rate == 0 else f"poisson({rate}/s)",
+        "params": cfg.num_params(),
+        "device": str(dev.device_kind),
+        "baseline": "fp32 pages at the SAME hbm_budget_bytes (page-"
+                    "limited) on the same stream",
+    }
+    _emit("gpt2_serving_quantkv_goodput_tokens_per_sec",
+          q8["goodput_tokens_per_sec"], "tokens/sec", goodput_ratio,
+          extras=extras)
+    # gate lanes: admitted pages (higher-better by explicit override)
+    # and HBM per token (lower-better by name)
+    _emit("gpt2_serving_quantkv_admitted_pages", q8["admitted_pages"],
+          "pages", pages_ratio,
+          extras={"fp32_admitted_pages": fp["admitted_pages"],
+                  "ratio_vs_fp32": pages_ratio})
+    _emit("gpt2_serving_quantkv_kv_bytes_per_token",
+          q8["kv_bytes_per_token"], "bytes", pages_ratio,
+          extras={"fp32_kv_bytes_per_token": fp["kv_bytes_per_token"]})
+    ok = (pages_ratio >= 1.8
+          and q8["steady_state_compiles"] == 0
+          and fp["steady_state_compiles"] == 0
+          and not q8["audit_leaks"] and not fp["audit_leaks"]
+          and q8["finished"] == n_requests
+          and fp["finished"] == n_requests
+          and goodput_ratio >= 0.9
+          and agreement >= 0.6
+          and tv <= 0.30)
+    return 0 if ok else 1
+
+
 def bench_gpt2_serving_http():
     """HTTP ingress overhead + robustness: the SAME greedy Poisson
     stream served (A) in-process — requests submitted straight into a
@@ -2162,6 +2367,9 @@ def main():
     if workload in ("serving_chunked", "chunked", "chunked_prefill",
                     "gpt2_serving_chunked"):
         return bench_gpt2_serving_chunked()
+    if workload in ("serving_quantkv", "quantkv", "int8_kv",
+                    "gpt2_serving_quantkv"):
+        return bench_gpt2_serving_quantkv()
     if workload in ("serving_http", "http", "frontend",
                     "gpt2_serving_http"):
         return bench_gpt2_serving_http()

@@ -138,12 +138,22 @@ class GPT2Attention(HybridBlock):
         ctx = _adapter_ctx
         if ctx is None or layer_idx is None:
             return y
-        A, B, scale, slots = ctx
+        # 4-tuple = float slab; 6-tuple = int8 slab with per-(proj,
+        # layer, slot) dequant scales appended (serving.AdapterPool
+        # quantized mode) — dequant on the gathered slot slices, so HBM
+        # traffic for the slab stays one byte per element
+        A, B, scale, slots = ctx[:4]
         xd = x._data if isinstance(x, NDArray) else x
         ag = jnp.take(A[pidx, layer_idx], slots, axis=0)   # (Bsz, U, R)
         bg = jnp.take(B[pidx, layer_idx], slots, axis=0)   # (Bsz, R, U)
         s = jnp.take(scale, slots, axis=0)                 # (Bsz,)
-        d = jnp.einsum("btu,bur->btr", xd.astype(A.dtype), ag)
+        if len(ctx) == 6:
+            asc, bsc = ctx[4], ctx[5]
+            sa = jnp.take(asc[pidx, layer_idx], slots, axis=0)  # (Bsz,)
+            sb = jnp.take(bsc[pidx, layer_idx], slots, axis=0)
+            ag = ag.astype(jnp.float32) * sa[:, None, None]
+            bg = bg.astype(jnp.float32) * sb[:, None, None]
+        d = jnp.einsum("btu,bur->btr", xd.astype(ag.dtype), ag)
         d = jnp.einsum("btr,bru->btu", d, bg)
         d = (d.astype(jnp.float32) * s[:, None, None]).astype(xd.dtype)
         yd = y._data if isinstance(y, NDArray) else y
@@ -192,7 +202,8 @@ class GPT2Attention(HybridBlock):
             impl = cache.attn_impl
             interp = impl == "pallas_interpret"
             impl = "pallas" if interp else impl
-            if t == 1:
+            quant = getattr(cache, "quantized", False)
+            if t == 1 and not quant:
                 out = ragged_decode_attention(
                     q._data[:, :, 0, :].astype(cache.k_pages.dtype),
                     cache.k_pages[layer_idx], cache.v_pages[layer_idx],
@@ -201,13 +212,22 @@ class GPT2Attention(HybridBlock):
                 b, h, d = out.shape
                 out = out.astype(q._data.dtype).reshape(b, 1, h * d)
             else:
+                # int8 pages keep q in its own compute dtype (casting q
+                # to the pool dtype would destroy it) and thread the
+                # per-(page, head) scales into the fused dequant; t == 1
+                # quantized decode rides the span kernel too so the
+                # dequant epilogue is a single code path
+                qd = q._data.transpose(0, 2, 1, 3)
+                if not quant:
+                    qd = qd.astype(cache.k_pages.dtype)
                 out = ragged_span_attention(
-                    q._data.transpose(0, 2, 1, 3).astype(
-                        cache.k_pages.dtype),
+                    qd,
                     cache.k_pages[layer_idx], cache.v_pages[layer_idx],
                     cache.page_table, cache.length + 1,
                     q_counts=getattr(cache, "spans", None),
-                    impl=impl, interpret=interp)
+                    impl=impl, interpret=interp,
+                    k_scale=cache.k_scale[layer_idx] if quant else None,
+                    v_scale=cache.v_scale[layer_idx] if quant else None)
                 b, tq, h, d = out.shape
                 out = out.astype(q._data.dtype).reshape(b, tq, h * d)
             out = NDArray(out)
@@ -318,11 +338,14 @@ class GPT2ForCausalLM(HybridBlock):
     # -- decode -----------------------------------------------------------
     def make_cache(self, batch, max_length, paged=False, page_size=64,
                    dtype=None, page_table=None, lengths=None,
-                   attn_impl="auto"):
+                   attn_impl="auto", kv_dtype=None):
         c = self.config
         cls = PagedKVCache if paged else KVCache
+        if kv_dtype is not None and not paged:
+            raise MXNetError("kv_dtype needs a paged cache")
         kw = dict(page_size=page_size, page_table=page_table,
-                  lengths=lengths, attn_impl=attn_impl) if paged else {}
+                  lengths=lengths, attn_impl=attn_impl,
+                  kv_dtype=kv_dtype) if paged else {}
         return cls.create(c.num_layers, batch, c.num_heads, max_length,
                           c.units // c.num_heads,
                           dtype=dtype or jnp.dtype(c.dtype), **kw)

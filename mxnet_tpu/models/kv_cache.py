@@ -102,10 +102,26 @@ class PagedKVCache:
     """Page-pool cache: k/v pools (L, num_pages, page_size, H, D) indexed
     through a per-sequence page_table (B, pages_per_seq). `length` is a
     scalar (all sequences in lockstep — generate()'s fixed-batch decode)
-    or a (B,) vector (ragged serving decode, one live length per slot)."""
+    or a (B,) vector (ragged serving decode, one live length per slot).
+
+    QUANTIZED page mode (``kv_dtype="int8"``): pools are stored int8
+    with per-page-per-head f32 scale leaves ``k_scale``/``v_scale`` of
+    shape (L, num_pages, H) riding in the pytree. Writes quantize
+    in-program with a MONOTONE scale: position i's scale is the running
+    max of absmax/127 over every position ever written to its page up
+    through i (gathered old page scale ⊔ within-write same-page running
+    max), so already-written int8 codes are never re-rounded and the
+    codes are a pure function of the token stream — independent of how
+    prefill was chunked. Reads dequantize with the CURRENT page scale
+    (earlier positions come back slightly inflated when the scale grew
+    after they were written; the tolerance oracle bounds this). Dequant
+    happens where the page bytes are touched — fused into the ragged
+    Pallas kernel's page DMA (ops/pallas_attention) or on the gathered
+    view for the lockstep path — so HBM traffic stays int8."""
 
     def __init__(self, k_pages, v_pages, page_table, length,
-                 page_lock=None, spans=None, attn_impl="auto"):
+                 page_lock=None, spans=None, k_scale=None, v_scale=None,
+                 attn_impl="auto"):
         self.k_pages = k_pages
         self.v_pages = v_pages
         self.page_table = page_table
@@ -123,12 +139,17 @@ class PagedKVCache:
         # kernel masks them to exact zeros) — the unified fixed-shape
         # serving dispatch rides on this
         self.spans = spans
+        # optional (L, num_pages, H) f32: per-page-per-head dequant
+        # scales for int8 pools — None on float caches
+        self.k_scale = k_scale
+        self.v_scale = v_scale
         self.attn_impl = attn_impl
 
     @classmethod
     def create(cls, num_layers, batch, num_heads, max_length, head_dim,
                dtype=jnp.float32, page_size=64, num_pages=None,
-               page_table=None, lengths=None, attn_impl="auto"):
+               page_table=None, lengths=None, attn_impl="auto",
+               kv_dtype=None):
         if max_length % page_size:
             raise MXNetError(
                 f"max_length {max_length} not a multiple of page_size "
@@ -155,12 +176,26 @@ class PagedKVCache:
                     f"page_table references pages outside the pool: "
                     f"entries span [{int(tbl.min())}, {int(tbl.max())}] "
                     f"but only pages [0, {num_pages}) exist")
+        if kv_dtype is not None and jnp.dtype(kv_dtype) != jnp.int8:
+            raise MXNetError(
+                f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+        store = jnp.int8 if kv_dtype is not None else dtype
         shape = (num_layers, num_pages, page_size, num_heads, head_dim)
         length = jnp.zeros((), jnp.int32) if lengths is None \
             else jnp.asarray(lengths, jnp.int32)
-        return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+        scales = (None, None)
+        if kv_dtype is not None:
+            sshape = (num_layers, num_pages, num_heads)
+            scales = (jnp.zeros(sshape, jnp.float32),
+                      jnp.zeros(sshape, jnp.float32))
+        return cls(jnp.zeros(shape, store), jnp.zeros(shape, store),
                    jnp.asarray(page_table, jnp.int32), length,
+                   k_scale=scales[0], v_scale=scales[1],
                    attn_impl=attn_impl)
+
+    @property
+    def quantized(self):
+        return self.k_scale is not None
 
     @property
     def ragged(self):
@@ -174,15 +209,60 @@ class PagedKVCache:
     def max_length(self):
         return self.page_table.shape[1] * self.page_size
 
-    def _gather(self, pages, layer):
+    def _gather(self, pages, layer, scale=None):
         # (num_pages, page_size, H, D)[table (B, P)] → (B, T, H, D) → BHTD
         g = jnp.take(pages[layer], self.page_table, axis=0)
+        if scale is not None:
+            # dequant the gathered view: one f32 scale per (page, head)
+            gs = jnp.take(scale[layer], self.page_table, axis=0)
+            g = g.astype(jnp.float32) * gs[:, :, None, :, None]
         B, P, S, H, D = g.shape
         return g.reshape(B, P * S, H, D).transpose(0, 2, 1, 3)
 
+    def _quant_encode(self, x_t, pages, page_idx, scale, layer):
+        """Quantize an append chunk against the monotone page scales.
+
+        x_t (B, t, H, D) float activations; pages (B, t) physical page
+        per position (num_pages = dropped row); page_idx (B, t) logical
+        page per position; scale the (L, N, H) leaf. Position i's scale
+        is max(old page scale, running same-page absmax/127 through i) —
+        the running max (not the chunk max) makes the emitted int8 codes
+        of GIVEN values independent of how the stream was cut into
+        chunks. (The values themselves are not: a mid-chunk row's
+        attention reads page scales that already reflect the whole
+        chunk, so deep-layer activations depend on chunk boundaries —
+        the serving engine replays a request's recorded write schedule
+        on restart/migration for exactly that reason.) Returns
+        (q int8 (B,t,H,D), scale_used f32 (B,t,H))."""
+        N = self.k_pages.shape[1]
+        xf = x_t.astype(jnp.float32)
+        live = pages < N                               # (B, t)
+        a = jnp.max(jnp.abs(xf), axis=-1)              # (B, t, H)
+        # dead rows carry garbage activations — they must not raise the
+        # scale of live rows sharing their page
+        a = jnp.where(live[..., None], a, 0.0)
+        t = x_t.shape[1]
+        i = jnp.arange(t)
+        same = (page_idx[:, :, None] == page_idx[:, None, :]) \
+            & (i[None, :, None] >= i[None, None, :])   # (B, i, j): j<=i
+        run = jnp.max(jnp.where(same[..., None], a[:, None, :, :], 0.0),
+                      axis=2)                          # (B, t, H)
+        s_old = jnp.take(scale[layer], jnp.minimum(pages, N - 1), axis=0)
+        s_old = jnp.where(live[..., None], s_old, 0.0)
+        s = jnp.maximum(s_old, run * (1.0 / 127.0))
+        q = jnp.where(s[..., None] > 0, xf / s[..., None], 0.0)
+        q = jnp.clip(jnp.round(q), -127, 127).astype(jnp.int8)
+        return q, s
+
     def write(self, layer, k_new, v_new):
         """Decode write: k_new/v_new (B, H, 1, D) appended at `length`.
-        Returns full gathered (B, H, T_max, D) views + updated cache."""
+        Returns full gathered (B, H, T_max, D) views + updated cache.
+        Quantized caches route through the write_decode scatter (which
+        owns the scale bookkeeping) and return DEQUANTIZED f32 views."""
+        if self.quantized:
+            new = self.write_decode(layer, k_new, v_new)
+            return (new._gather(new.k_pages, layer, new.k_scale),
+                    new._gather(new.v_pages, layer, new.v_scale), new)
         page_idx = self.length // self.page_size
         slot = self.length % self.page_size
         pages = self.page_table[:, page_idx]          # (B,) physical page
@@ -240,6 +320,21 @@ class PagedKVCache:
             pages = jnp.where(locked, num_pages, pages)
         k_t = k_new.transpose(0, 2, 1, 3)             # (B, t, H, D)
         v_t = v_new.transpose(0, 2, 1, 3)
+        if self.quantized:
+            qk, sk = self._quant_encode(k_t, pages, page_idx,
+                                        self.k_scale, layer)
+            qv, sv = self._quant_encode(v_t, pages, page_idx,
+                                        self.v_scale, layer)
+            kp = self.k_pages.at[layer, pages, slot].set(qk, mode="drop")
+            vp = self.v_pages.at[layer, pages, slot].set(qv, mode="drop")
+            # scatter-max keeps the monotone invariant under duplicate
+            # page indices; dropped rows never touch the scale either
+            ks = self.k_scale.at[layer, pages].max(sk, mode="drop")
+            vs = self.v_scale.at[layer, pages].max(sv, mode="drop")
+            return PagedKVCache(kp, vp, self.page_table, self.length,
+                                page_lock=self.page_lock, spans=self.spans,
+                                k_scale=ks, v_scale=vs,
+                                attn_impl=self.attn_impl)
         kp = self.k_pages.at[layer, pages, slot].set(
             k_t.astype(self.k_pages.dtype), mode="drop")
         vp = self.v_pages.at[layer, pages, slot].set(
@@ -264,13 +359,14 @@ class PagedKVCache:
                              "(scalar length); ragged slots prefill "
                              "individually (serving.ServingEngine)")
         new = self.write_decode(layer, k, v)
-        return (new._gather(new.k_pages, layer),
-                new._gather(new.v_pages, layer), new)
+        return (new._gather(new.k_pages, layer, new.k_scale),
+                new._gather(new.v_pages, layer, new.v_scale), new)
 
     def advance(self, n):
         return PagedKVCache(self.k_pages, self.v_pages, self.page_table,
                             self.length + n, page_lock=self.page_lock,
-                            spans=self.spans, attn_impl=self.attn_impl)
+                            spans=self.spans, k_scale=self.k_scale,
+                            v_scale=self.v_scale, attn_impl=self.attn_impl)
 
     def key_mask(self, extra=0):
         """Validity over key positions: (T_max,) in lockstep mode,
@@ -282,7 +378,8 @@ class PagedKVCache:
 
     def tree_flatten(self):
         return (self.k_pages, self.v_pages, self.page_table,
-                self.length, self.page_lock, self.spans), self.attn_impl
+                self.length, self.page_lock, self.spans,
+                self.k_scale, self.v_scale), self.attn_impl
 
     @classmethod
     def tree_unflatten(cls, aux, children):

@@ -458,6 +458,8 @@ def ragged_supported(q, k_pages):
         return False   # packed head slices must be 64-aligned lane blocks
     if S % 8:
         return False   # sublane rule for the (S, H*D) page blocks
+    if k_pages.dtype == jnp.int8 and S % 32:
+        return False   # int8 page blocks need the (32, 128) min tile
     if q.dtype not in (jnp.float32, jnp.bfloat16):
         return False
     return True
@@ -628,16 +630,87 @@ def _ragged_span_kernel(table_ref, len_ref, qc_ref, q_ref, k_ref, v_ref,
                 / jnp.maximum(l_ref[h][:, :1], 1e-30)).astype(o_ref.dtype)
 
 
-def _ragged_mq_reference(q, k_pages, v_pages, page_table, lengths, scale):
+def _ragged_span_quant_kernel(table_ref, len_ref, qc_ref, kscale_ref,
+                              vscale_ref, q_ref, k_ref, v_ref, o_ref,
+                              m_ref, l_ref, acc_ref, *, scale, S, Sq,
+                              H, D):
+    """Span kernel over int8 pages with a fused dequant epilogue on the
+    page DMA: the per-(page, head) f32 scales ride the scalar-prefetch
+    lane next to the page table, the kernel recomputes this grid step's
+    physical page (the same remap page_index uses, so the looked-up
+    scale always matches the block the DMA fetched) and widens the int8
+    page block in VMEM — HBM traffic stays one byte per element."""
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    length = len_ref[b]
+    qn = qc_ref[b]
+    n_live = jnp.where(qn == 0, 0, (length + qn - 1 + S - 1) // S)
+    # mirror of page_index's DMA-eliding remap: which physical page is
+    # actually sitting in k_ref/v_ref right now
+    last_live = jnp.maximum((length + qn - 1 + S - 1) // S - 1, 0)
+    page_phys = table_ref[b, jnp.minimum(p, last_live)]
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(p < n_live)
+    def _accumulate():
+        rows = lax.broadcasted_iota(jnp.int32, (Sq, S), 0)
+        cols = p * S + lax.broadcasted_iota(jnp.int32, (Sq, S), 1)
+        valid = (cols < length + rows) & (rows < qn)
+        for h in range(H):
+            c0, c1 = h * D, (h + 1) * D
+            q = q_ref[0, :, c0:c1]                     # (Sq, D)
+            k = k_ref[0, :, c0:c1].astype(jnp.float32) \
+                * kscale_ref[page_phys, h]             # (S, D) dequant
+            s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+            s = jnp.where(valid, s, NEG_INF)           # (Sq, S)
+            m_prev = m_ref[h][:, :1]                   # (Sq, 1)
+            l_prev = l_ref[h][:, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            e = jnp.where(m_new <= NEG_INF / 2, 0.0, jnp.exp(s - m_new))
+            alpha = jnp.where(m_new <= NEG_INF / 2, 1.0,
+                              jnp.exp(m_prev - m_new))
+            v = v_ref[0, :, c0:c1].astype(jnp.float32) \
+                * vscale_ref[page_phys, h]             # (S, D) dequant
+            pv = lax.dot_general(e, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+            acc_ref[h] = acc_ref[h] * alpha + pv
+            l_new = l_prev * alpha + jnp.sum(e, axis=-1, keepdims=True)
+            l_ref[h] = jnp.broadcast_to(l_new, l_ref[h].shape)
+            m_ref[h] = jnp.broadcast_to(m_new, m_ref[h].shape)
+
+    @pl.when(p == pl.num_programs(1) - 1)
+    def _emit():
+        for h in range(H):
+            c0, c1 = h * D, (h + 1) * D
+            o_ref[0, :, c0:c1] = (
+                acc_ref[h]
+                / jnp.maximum(l_ref[h][:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _ragged_mq_reference(q, k_pages, v_pages, page_table, lengths, scale,
+                         k_scale=None, v_scale=None):
     """Dense XLA fallback/oracle for the multi-query kernel: full gather,
     per-position causal-offset mask — query j of slot b attends key
-    positions < lengths[b] + j."""
+    positions < lengths[b] + j. int8 pools dequant on the gathered view
+    with the per-(page, head) scales — the same math the fused kernel
+    epilogue applies in VMEM."""
     B, Sq = q.shape[0], q.shape[1]
     g = jnp.take(k_pages, page_table, axis=0)          # (B, P, S, H, D)
     P, S = g.shape[1], g.shape[2]
+    gv = jnp.take(v_pages, page_table, axis=0)
+    if k_scale is not None:
+        ks = jnp.take(k_scale, page_table, axis=0)     # (B, P, H)
+        vs = jnp.take(v_scale, page_table, axis=0)
+        g = g.astype(jnp.float32) * ks[:, :, None, :, None]
+        gv = gv.astype(jnp.float32) * vs[:, :, None, :, None]
     k = g.reshape(B, P * S, *g.shape[3:])              # (B, T, H, D)
-    v = jnp.take(v_pages, page_table, axis=0).reshape(B, P * S,
-                                                      *g.shape[3:])
+    v = gv.reshape(B, P * S, *g.shape[3:])
     s = jnp.einsum("bjhd,bthd->bjht", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     limit = lengths[:, None] + jnp.arange(Sq)[None, :]     # (B, Sq)
@@ -653,13 +726,13 @@ def _ragged_mq_reference(q, k_pages, v_pages, page_table, lengths, scale):
 
 
 def _ragged_span_reference(q, k_pages, v_pages, page_table, lengths,
-                           q_counts, scale):
+                           q_counts, scale, k_scale=None, v_scale=None):
     """Dense XLA fallback/oracle for the span kernel: the multi-query
     causal-offset math, with query rows >= q_counts[b] dead — they emit
     exact zeros (the row-mask contract the unified dispatch relies on:
     garbage rows of a mixed batch can never leak into live output)."""
     out = _ragged_mq_reference(q, k_pages, v_pages, page_table, lengths,
-                               scale)
+                               scale, k_scale=k_scale, v_scale=v_scale)
     rows = jnp.arange(q.shape[1])[None, :] < q_counts[:, None]  # (B, Sq)
     return jnp.where(rows[:, :, None, None], out,
                      jnp.zeros_like(out))
@@ -667,7 +740,7 @@ def _ragged_span_reference(q, k_pages, v_pages, page_table, lengths,
 
 def ragged_span_attention(q, k_pages, v_pages, page_table, lengths,
                           q_counts=None, scale=None, impl="auto",
-                          interpret=False):
+                          interpret=False, k_scale=None, v_scale=None):
     """Span ragged paged-attention: ONE fixed-shape program for mixed
     prefill-chunk / decode / speculative-verify / idle work.
 
@@ -683,6 +756,10 @@ def ragged_span_attention(q, k_pages, v_pages, page_table, lengths,
                     verify=S, prefill chunk=C, idle=0); rows past the
                     count emit exact zeros. None means every row is
                     live (the multi-query/verify case).
+    k_scale/v_scale:(num_pages, H) f32 — per-(page, head) dequant scales
+                    for int8 page pools; both set or both None. The
+                    Pallas path fuses the dequant into the page DMA
+                    epilogue; the XLA path dequants the gathered view.
     impl/interpret: same contract as ragged_decode_attention. Sq=1 with
     q_counts=None matches the single-query kernel exactly.
     Returns (B, Sq, H, D) in q's dtype.
@@ -691,6 +768,7 @@ def ragged_span_attention(q, k_pages, v_pages, page_table, lengths,
     N, S = k_pages.shape[0], k_pages.shape[1]
     P = page_table.shape[1]
     s = float(scale) if scale is not None else 1.0 / math.sqrt(D)
+    quant = k_scale is not None
     if q_counts is None:
         q_counts = jnp.full((B,), Sq, jnp.int32)
     if impl == "auto":
@@ -699,7 +777,8 @@ def ragged_span_attention(q, k_pages, v_pages, page_table, lengths,
             else ("pallas" if interpret else "xla")
     if impl == "xla":
         return _ragged_span_reference(q, k_pages, v_pages, page_table,
-                                      lengths, q_counts, s)
+                                      lengths, q_counts, s,
+                                      k_scale=k_scale, v_scale=v_scale)
     if impl != "pallas":
         raise ValueError(f"unknown ragged attention impl {impl!r}")
     qp = q.reshape(B, Sq, H * D)
@@ -708,8 +787,11 @@ def ragged_span_attention(q, k_pages, v_pages, page_table, lengths,
     lengths = lengths.astype(jnp.int32)
     q_counts = q_counts.astype(jnp.int32)
     table = page_table.astype(jnp.int32)
+    # the scalar-prefetch index_map signature grows with every prefetch
+    # operand; the float path keeps its 3-operand spec byte-identical
+    n_scalar = 5 if quant else 3
 
-    def page_index(b, p, tbl, lens, qcs):
+    def page_index(b, p, tbl, lens, qcs, *_scales):
         # same DMA-eliding remap as the single-query kernel, with the
         # live extent stretched to cover the slot's furthest live query;
         # idle slots (q_count 0) pin every step to their first page and
@@ -717,25 +799,34 @@ def ragged_span_attention(q, k_pages, v_pages, page_table, lengths,
         last_live = jnp.maximum((lens[b] + qcs[b] - 1 + S - 1) // S - 1, 0)
         return (tbl[b, jnp.minimum(p, last_live)], 0, 0)
 
+    def q_index(b, p, tbl, lens, qcs, *_scales):
+        return (b, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=n_scalar,
         grid=(B, P),
         in_specs=[
-            pl.BlockSpec((1, Sq, H * D),
-                         lambda b, p, tbl, lens, qcs: (b, 0, 0)),
+            pl.BlockSpec((1, Sq, H * D), q_index),
             pl.BlockSpec((1, S, H * D), page_index),
             pl.BlockSpec((1, S, H * D), page_index),
         ],
-        out_specs=pl.BlockSpec((1, Sq, H * D),
-                               lambda b, p, tbl, lens, qcs: (b, 0, 0)),
+        out_specs=pl.BlockSpec((1, Sq, H * D), q_index),
         scratch_shapes=[
             pltpu.VMEM((H, Sq, 128), jnp.float32),   # running max
             pltpu.VMEM((H, Sq, 128), jnp.float32),   # running denominator
             pltpu.VMEM((H, Sq, D), jnp.float32),     # running numerator
         ],
     )
-    kernel = functools.partial(_ragged_span_kernel, scale=s, S=S,
-                               Sq=Sq, H=H, D=D)
+    if quant:
+        kernel = functools.partial(_ragged_span_quant_kernel, scale=s,
+                                   S=S, Sq=Sq, H=H, D=D)
+        operands = (table, lengths, q_counts,
+                    k_scale.astype(jnp.float32),
+                    v_scale.astype(jnp.float32), qp, kp, vp)
+    else:
+        kernel = functools.partial(_ragged_span_kernel, scale=s, S=S,
+                                   Sq=Sq, H=H, D=D)
+        operands = (table, lengths, q_counts, qp, kp, vp)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -744,7 +835,7 @@ def ragged_span_attention(q, k_pages, v_pages, page_table, lengths,
         compiler_params=None if interpret else pltpu.CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024,
             dimension_semantics=("parallel", "arbitrary")),
-    )(table, lengths, q_counts, qp, kp, vp)
+    )(*operands)
     return out.reshape(B, Sq, H, D)
 
 

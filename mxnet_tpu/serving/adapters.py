@@ -108,11 +108,22 @@ class AdapterPool:
         self.max_rank = int(max_rank)
         L, U = config.num_layers, config.units
         self.dtype = jnp.dtype(dtype or getattr(config, "dtype", "float32"))
+        # dtype="int8" packs the slab quantized: per-(proj, layer, slot)
+        # absmax/127 dequant scales ride next to it and gpt2._lora
+        # widens the gathered slot slices in-register — LoRA deltas are
+        # tiny and tolerance-friendly, so the slab drops to a quarter
+        # (fp32) of its bytes in the HBM ledger's adapter_slab entry
+        self.quantized = self.dtype == jnp.int8
         self.A = jnp.zeros((4, L, self.slots, U, self.max_rank),
                            self.dtype)
         self.B = jnp.zeros((4, L, self.slots, self.max_rank, U),
                            self.dtype)
         self.scale = jnp.zeros((self.slots,), jnp.float32)
+        if self.quantized:
+            self.a_scale = jnp.zeros((4, L, self.slots), jnp.float32)
+            self.b_scale = jnp.zeros((4, L, self.slots), jnp.float32)
+        else:
+            self.a_scale = self.b_scale = None
         self._registry = {}             # adapter_id -> host weights
         self._slot_of = {}              # adapter_id -> resident slot
         self._adapter_at = [None] * self.slots   # slot -> adapter_id
@@ -152,7 +163,10 @@ class AdapterPool:
         return int(self._pins[slot]) if slot is not None else 0
 
     def slab_bytes(self):
-        return int(self.A.nbytes + self.B.nbytes + self.scale.nbytes)
+        n = self.A.nbytes + self.B.nbytes + self.scale.nbytes
+        if self.quantized:
+            n += self.a_scale.nbytes + self.b_scale.nbytes
+        return int(n)
 
     # -- host-side registry ------------------------------------------------
     def register(self, adapter_id, weights):
@@ -195,11 +209,31 @@ class AdapterPool:
         import jax
         # donate the slab so page-in updates in place; `slot` is traced —
         # one compile serves every slot forever
+        if self.quantized:
+            def upload_q(A, B, scale, a_sc, b_sc, slot, a_pad, b_pad, s,
+                         sa, sb):
+                return (A.at[:, :, slot].set(a_pad),
+                        B.at[:, :, slot].set(b_pad),
+                        scale.at[slot].set(s),
+                        a_sc.at[:, :, slot].set(sa),
+                        b_sc.at[:, :, slot].set(sb))
+            return jax.jit(upload_q, donate_argnums=(0, 1, 2, 3, 4))
+
         def upload(A, B, scale, slot, a_pad, b_pad, s):
             return (A.at[:, :, slot].set(a_pad),
                     B.at[:, :, slot].set(b_pad),
                     scale.at[slot].set(s))
         return jax.jit(upload, donate_argnums=(0, 1, 2))
+
+    @staticmethod
+    def _quantize_proj(w):
+        """Host-side symmetric int8 quantization of a padded (4, L, …)
+        delta slab slice: one absmax/127 scale per (proj, layer)."""
+        sa = np.abs(w).max(axis=tuple(range(2, w.ndim))) / 127.0  # (4, L)
+        s = sa[..., None, None]
+        q = np.where(s > 0, np.round(w / np.maximum(s, 1e-30)), 0.0)
+        return np.clip(q, -127, 127).astype(np.int8), \
+            sa.astype(np.float32)
 
     def _page_in(self, slot, adapter_id):
         w = self._registry[adapter_id]
@@ -209,13 +243,39 @@ class AdapterPool:
         b_pad = np.zeros((4, L, R, U), np.float32)
         a_pad[..., :r] = w["A"]
         b_pad[:, :, :r, :] = w["B"]
-        self.A, self.B, self.scale = self._upload(
-            self.A, self.B, self.scale, np.int32(slot),
-            a_pad.astype(self.dtype), b_pad.astype(self.dtype),
-            np.float32(w["alpha"] / r))
+        if self.quantized:
+            qa, sa = self._quantize_proj(a_pad)
+            qb, sb = self._quantize_proj(b_pad)
+            (self.A, self.B, self.scale, self.a_scale,
+             self.b_scale) = self._upload(
+                self.A, self.B, self.scale, self.a_scale, self.b_scale,
+                np.int32(slot), qa, qb, np.float32(w["alpha"] / r),
+                sa, sb)
+        else:
+            self.A, self.B, self.scale = self._upload(
+                self.A, self.B, self.scale, np.int32(slot),
+                a_pad.astype(self.dtype), b_pad.astype(self.dtype),
+                np.float32(w["alpha"] / r))
         self._slot_of[adapter_id] = slot
         self._adapter_at[slot] = adapter_id
         self.page_ins += 1
+
+    def effective_weights(self, adapter_id):
+        """The weights a served request actually sees: the registered
+        host weights, round-tripped through the slab's int8
+        quantization when the pool is quantized — feed these to
+        ``merged_weights`` to build the dense oracle for a quantized
+        pool."""
+        w = self._registry[adapter_id]
+        if not self.quantized:
+            return w
+        qa, sa = self._quantize_proj(w["A"])
+        qb, sb = self._quantize_proj(w["B"])
+        return {
+            "A": qa.astype(np.float32) * sa[..., None, None],
+            "B": qb.astype(np.float32) * sb[..., None, None],
+            "alpha": w["alpha"], "rank": w["rank"],
+        }
 
     def _find_slot(self):
         """A slab slot for a page-in: a never-used slot, else LRU-evict

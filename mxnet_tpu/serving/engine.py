@@ -255,6 +255,19 @@ def _engine_metrics(eid):
             "serving_adapter_slab_bytes",
             "device bytes held by the LoRA adapter slab (A + B + "
             "scale)", _E),
+        "kv_quant_enabled": g(
+            "serving_kv_quant_enabled",
+            "1 when the KV page pools store int8 codes with per-page "
+            "dequant scales (kv_dtype=\"int8\"), else 0", _E),
+        "kv_page_bytes": g(
+            "serving_kv_page_bytes",
+            "HBM bytes one KV page really costs: k+v slabs across all "
+            "layers plus the per-page dequant scales when quantized", _E),
+        "kv_bytes_per_token": g(
+            "serving_kv_bytes_per_token",
+            "KV-cache HBM bytes per token position "
+            "(kv_page_bytes / page_size) — the capacity headline int8 "
+            "pages shrink ~4x", _E),
     }
     _shed_family()                  # registered per-process; children
     _tenant_families()
@@ -364,7 +377,8 @@ class ServingEngine:
                  spec_tokens=4, spec_max_ngram=3, spec_min_ngram=1,
                  num_priorities=3, policy=None, max_retries=3,
                  retry_backoff_s=0.02, clock=None, adapter_pool=None,
-                 tenant_quotas=None):
+                 tenant_quotas=None, kv_dtype=None,
+                 hbm_budget_bytes=None):
         self.model = model
         cfg = model.config
         self.num_slots = int(num_slots)
@@ -443,11 +457,47 @@ class ServingEngine:
                 raise MXNetError("prefix_cache_pages must be >= 0")
         total_pages = B * P + extra
         dt = dtype or jnp.dtype(cfg.dtype)
-        pool_shape = (cfg.num_layers, total_pages, page_size,
-                      cfg.num_heads, cfg.units // cfg.num_heads)
-        self._kp = jnp.zeros(pool_shape, dt)
-        self._vp = jnp.zeros(pool_shape, dt)
-        self.page_pool = PagePool(total_pages)
+        # quantized page mode (docs/SERVING.md "Quantized KV pages"):
+        # int8 codes + per-(layer, page, head) f32 dequant scales kept
+        # as separate pool leaves. page_bytes is the HONEST per-page
+        # HBM cost (k+v slabs across all layers, plus scales) — the
+        # byte-denominated budget below trades the ~4x smaller pages
+        # for MORE pages, i.e. real admitted capacity.
+        if kv_dtype is not None:
+            try:
+                ok = jnp.dtype(kv_dtype) == jnp.int8
+            except TypeError:
+                ok = False
+            if not ok:
+                raise MXNetError(f"kv_dtype {kv_dtype!r} unsupported "
+                                 "(int8 or None)")
+        self._quant = kv_dtype is not None
+        self.kv_dtype = "int8" if self._quant else str(jnp.dtype(dt))
+        store = jnp.dtype(jnp.int8) if self._quant else jnp.dtype(dt)
+        L, H = cfg.num_layers, cfg.num_heads
+        Dh = cfg.units // cfg.num_heads
+        page_bytes = 2 * L * page_size * H * Dh * store.itemsize
+        if self._quant:
+            page_bytes += 2 * L * H * 4    # f32 scales ride each page
+        self._hbm_budget = None if hbm_budget_bytes is None \
+            else int(hbm_budget_bytes)
+        if self._hbm_budget is not None:
+            afford = self._hbm_budget // page_bytes
+            if afford < P:
+                raise MXNetError(
+                    f"hbm_budget_bytes {self._hbm_budget} affords "
+                    f"{afford} pages at {page_bytes} B/page — below the "
+                    f"{P} pages one full-length slot needs")
+            total_pages = min(total_pages, afford)
+        pool_shape = (L, total_pages, page_size, H, Dh)
+        self._kp = jnp.zeros(pool_shape, store)
+        self._vp = jnp.zeros(pool_shape, store)
+        if self._quant:
+            self._ks = jnp.zeros((L, total_pages, H), jnp.float32)
+            self._vs = jnp.zeros((L, total_pages, H), jnp.float32)
+        else:
+            self._ks = self._vs = None
+        self.page_pool = PagePool(total_pages, page_bytes=page_bytes)
         self.prefix_cache = PrefixCache(self.page_pool, page_size,
                                         budget_pages=extra) \
             if prefix_cache else None
@@ -487,6 +537,13 @@ class ServingEngine:
         # prefill_chunk_budget, starting at a rotating slot cursor.
         self._pending = [None] * B
         self._base = np.zeros(B, np.int32)   # resume offset per slot
+        # quantized restart replay: when a slot re-prefills a request
+        # that already emitted tokens, this holds the exact chunk sizes
+        # to feed (deque; None = feed on the natural chunk_tokens
+        # grid). See _admit — per-page dequant scales make deep-layer
+        # KV codes chunk-boundary-dependent, so only replaying the
+        # recorded write schedule keeps the continuation bit-identical.
+        self._replay = [None] * B
         self._chunk_rr = 0
         # the unified program comes in two flavors selected PER
         # DISPATCH: the general mixed-sampling one and a greedy-only
@@ -496,12 +553,38 @@ class ServingEngine:
         # two keys are the engine's ENTIRE program registry.
         self._programs = {}
 
-        def _copy_page(kp, vp, src, dst):
-            # CoW split: clone one physical page's (L, S, H, D) slab
-            return (kp.at[:, dst].set(kp[:, src]),
-                    vp.at[:, dst].set(vp[:, src]))
+        if self._quant:
+            def _copy_page(kp, vp, ks, vs, src, dst):
+                # CoW split: the dequant scales are part of a page's
+                # identity — they travel with the slab on every clone
+                return (kp.at[:, dst].set(kp[:, src]),
+                        vp.at[:, dst].set(vp[:, src]),
+                        ks.at[:, dst].set(ks[:, src]),
+                        vs.at[:, dst].set(vs[:, src]))
 
-        self._copy_page_fn = jax.jit(_copy_page, donate_argnums=(0, 1))
+            self._copy_page_fn = jax.jit(_copy_page,
+                                         donate_argnums=(0, 1, 2, 3))
+
+            def _zero_scales(ks, vs, idx):
+                # fresh pages must start from scale 0 or the monotone
+                # max-update would inherit a recycled page's old scale;
+                # idx is FIXED-length (padded with an out-of-range id
+                # that mode="drop" ignores) so admissions never mint
+                # new program shapes in steady state
+                z = jnp.zeros((), jnp.float32)
+                return (ks.at[:, idx].set(z, mode="drop"),
+                        vs.at[:, idx].set(z, mode="drop"))
+
+            self._zero_scales_fn = jax.jit(_zero_scales,
+                                           donate_argnums=(0, 1))
+        else:
+            def _copy_page(kp, vp, src, dst):
+                # CoW split: clone one physical page's (L, S, H, D) slab
+                return (kp.at[:, dst].set(kp[:, src]),
+                        vp.at[:, dst].set(vp[:, src]))
+
+            self._copy_page_fn = jax.jit(_copy_page,
+                                         donate_argnums=(0, 1))
         # the per-slot scalar state is DEVICE-RESIDENT between decode
         # dispatches: the decode program reads these arrays directly and
         # returns the updated ones, and the host uploads deltas only on
@@ -520,6 +603,7 @@ class ServingEngine:
         self._eid = str(next(_engine_ids))
         self._metrics = _engine_metrics(self._eid)
         self._metrics["num_slots"].set(self.num_slots)
+        self._set_static_gauges()
         self._shed = _shed_family()
         self._shed_children = {}   # (reason, priority) -> labeled child
         self._shed_counts = {}     # same keys, host-side for stats
@@ -609,6 +693,10 @@ class ServingEngine:
             "adapter_evictions": int(m["adapter_evictions"].value),
             "adapter_resident": int(m["adapter_resident"].value),
             "adapter_pinned": int(m["adapter_pinned"].value),
+            "kv_quant_enabled": int(m["kv_quant_enabled"].value),
+            "kv_page_bytes": int(m["kv_page_bytes"].value),
+            "kv_bytes_per_token": float(
+                m["kv_bytes_per_token"].value),
         }
 
     def tenant_stats(self):
@@ -621,6 +709,14 @@ class ServingEngine:
             row.setdefault("shed", {})[reason] = n
         return out
 
+    def _set_static_gauges(self):
+        """Configuration gauges — set at construction and re-applied
+        after reset_stats (they describe the engine, not traffic)."""
+        pb = self.page_pool.page_bytes
+        self._metrics["kv_quant_enabled"].set(int(self._quant))
+        self._metrics["kv_page_bytes"].set(pb)
+        self._metrics["kv_bytes_per_token"].set(pb / self.page_size)
+
     def reset_stats(self):
         """Zero this engine's telemetry children (other engines and the
         rest of the registry are untouched)."""
@@ -628,6 +724,8 @@ class ServingEngine:
             inst.reset()
         for child in self._shed_children.values():
             child.reset()
+        self._metrics["num_slots"].set(self.num_slots)
+        self._set_static_gauges()
         self._shed_counts = {}
         for child in self._tenant_children.values():
             child.reset()
@@ -772,6 +870,9 @@ class ServingEngine:
                 "max_retries": self.max_retries,
                 "retry_backoff_s": self.retry_backoff_s,
                 "total_pages": self.page_pool.num_pages,
+                "kv_dtype": self.kv_dtype,
+                "kv_page_bytes": self.page_pool.page_bytes,
+                "hbm_budget_bytes": self._hbm_budget,
                 "steady_state": self._steady,
                 "adapter_pool": self.adapter_pool is not None,
                 "adapter_slots": self.adapter_pool.slots
@@ -861,14 +962,20 @@ class ServingEngine:
         Weights are shared arrays (the ledger dedupes them across
         engines); the prefix-cache figure is a Detail — those pages
         live inside the kv_pages slab already counted above."""
+        kv = [self._kp, self._vp]
+        if self._quant:
+            kv += [self._ks, self._vs]   # dequant scales live with KV
         out = {
             "weights": [p.data() for p in self._params],
-            "kv_pages": [self._kp, self._vp],
+            "kv_pages": kv,
             "slot_state": list(self._dstate) + [self._d_lock],
         }
         pool = self.adapter_pool
         if pool is not None:
-            out["adapter_slab"] = [pool.A, pool.B, pool.scale]
+            slab = [pool.A, pool.B, pool.scale]
+            if pool.quantized:
+                slab += [pool.a_scale, pool.b_scale]
+            out["adapter_slab"] = slab
         # gluon-initialized params usually carry gradient buffers even
         # when only serving — account them so /memz reconciles
         grads = [g for g in (getattr(p._data, "_grad", None)
@@ -878,10 +985,8 @@ class ServingEngine:
             out["weight_grads"] = grads
         pc = self.prefix_cache
         if pc is not None:
-            per_page = (int(self._kp.nbytes) + int(self._vp.nbytes)) \
-                // self.page_pool.num_pages
             out["prefix_cache_pages"] = _ledger.Detail(
-                pc.num_pages * per_page)
+                pc.num_pages * self.page_pool.page_bytes)
         return out
 
     # -- admission control -------------------------------------------------
@@ -1351,7 +1456,16 @@ class ServingEngine:
         members = ()
         if self.prefix_cache is not None:
             members = np.nonzero(self.prefix_cache.member_mask())[0]
+        scales = None
+        if self._quant:
+            # per-page scale summary for the pool's lease-consistency
+            # check: the max magnitude over layers/heads — NaN/inf
+            # propagates and gets flagged as corrupt quant state
+            scales = np.maximum(
+                np.abs(np.asarray(self._ks)).max(axis=(0, 2)),
+                np.abs(np.asarray(self._vs)).max(axis=(0, 2)))
         return self.page_pool.audit(leases=leases, members=members,
+                                    scales=scales,
                                     raise_on_error=raise_on_error)
 
     @thread_safe
@@ -1444,6 +1558,7 @@ class ServingEngine:
         self._free_slot_pages(slot)
         self._release_adapter(slot)
         self._pending[slot] = None
+        self._replay[slot] = None
         self._done[slot] = True
         self._remaining[slot] = 0
         self._lengths[slot] = self.max_length
@@ -1505,6 +1620,12 @@ class ServingEngine:
         zero = jnp.zeros((), self._kp.dtype)
         self._kp = self._kp.at[:, idx].set(zero)
         self._vp = self._vp.at[:, idx].set(zero)
+        if self._quant:
+            # a poisoned slot may have bumped these pages' scales with
+            # NaN/inf absmaxes — scrub them with the codes
+            zs = jnp.zeros((), jnp.float32)
+            self._ks = self._ks.at[:, idx].set(zs)
+            self._vs = self._vs.at[:, idx].set(zs)
 
     def _on_bad_slots(self, bad, exc_msg):
         """Slots whose dispatch produced non-finite logits (the
@@ -1568,7 +1689,10 @@ class ServingEngine:
             return ()
         if isinstance(aslot, tuple):    # the _dstate tail
             aslot = aslot[0]
-        return (aslot, pool.A, pool.B, pool.scale)
+        args = (aslot, pool.A, pool.B, pool.scale)
+        if pool.quantized:
+            args = args + (pool.a_scale, pool.b_scale)
+        return args
 
     # -- pages -------------------------------------------------------------
     def _page_lock_host(self):
@@ -1580,7 +1704,7 @@ class ServingEngine:
             lock |= self.prefix_cache.member_mask()
         return lock
 
-    def _map_slot_pages(self, slot, tokens):
+    def _map_slot_pages(self, slot, tokens, match=True):
         """Page-table surgery for an admission (`tokens` = the ids the
         slot must hold: the prompt, plus already-emitted tokens when a
         rolled-back request restarts): longest-prefix match, CoW split
@@ -1588,11 +1712,13 @@ class ServingEngine:
         rest. Returns the prefix offset (tokens NOT recomputed; prefill
         starts there). On an allocation failure every lease taken by
         the match is released before the exception propagates — a
-        faulted admission must not leak refcounts."""
+        faulted admission must not leak refcounts. match=False skips
+        the prefix lookup (quantized restarts must recompute every
+        position to replay the recorded write schedule)."""
         S, P = self.page_size, self._pages_per_slot
         Tp = int(tokens.size)
         pc = self.prefix_cache
-        matched = pc.match(tokens) if pc is not None else []
+        matched = pc.match(tokens) if (pc is not None and match) else []
         leased = list(matched)         # every lease match() took
         cow_src = None
         if matched and len(matched) * S >= Tp:
@@ -1612,11 +1738,25 @@ class ServingEngine:
             if pc is not None and leased:
                 pc.release(leased)
             raise
+        if self._quant and fresh:
+            # reset recycled pages' dequant scales BEFORE any CoW copy
+            # lands (the copy then stamps the source page's scale over
+            # the zero). Fixed-length padded index: one compile, ever.
+            idx = np.full(P, self.page_pool.num_pages, np.int32)
+            idx[:len(fresh)] = fresh
+            self._ks, self._vs = self._zero_scales_fn(
+                self._ks, self._vs, jnp.asarray(idx))
         if cow_src is not None:
             dst = fresh[0]             # lands at row index n_shared
-            self._kp, self._vp = self._copy_page_fn(
-                self._kp, self._vp, jnp.asarray(cow_src, jnp.int32),
-                jnp.asarray(dst, jnp.int32))
+            src = jnp.asarray(cow_src, jnp.int32)
+            dsti = jnp.asarray(dst, jnp.int32)
+            if self._quant:
+                self._kp, self._vp, self._ks, self._vs = \
+                    self._copy_page_fn(self._kp, self._vp, self._ks,
+                                       self._vs, src, dsti)
+            else:
+                self._kp, self._vp = self._copy_page_fn(
+                    self._kp, self._vp, src, dsti)
             pc.release([cow_src])      # drop our lease on the source
             offset = Tp - 1
         else:
@@ -1673,7 +1813,8 @@ class ServingEngine:
         # pages: length starts at the cached offset and the queue holds
         # only the uncached tail (>= 1 token — a fully cached prompt is
         # re-homed by the CoW split to recompute its last position)
-        offset = self._map_slot_pages(slot, tokens)
+        offset = self._map_slot_pages(slot, tokens,
+                                      match=not (self._quant and base))
         req.status = "prefilling"
         if req.tenant is not None:
             self._tenant_child("admitted", req.tenant).inc()
@@ -1698,6 +1839,38 @@ class ServingEngine:
         # remaining when the first token is emitted.
         cap = min(req.max_new_tokens - base, self.max_length - Tp + 1)
         self._pending[slot] = np.asarray(tokens[offset:], np.int32)
+        if self._quant:
+            if base:
+                # Replay the recorded write schedule: prefix tokens the
+                # first admission attached (best-effort re-chunked on
+                # the natural grid — those positions were never computed
+                # here), then the recorded prefill chunks, then every
+                # emitted token as its own 1-token chunk, exactly how
+                # decode wrote it. The trim below keeps the plan honest
+                # if a replica with a different chunk_tokens adopted us.
+                plan, head = [], int(req.kv_attach)
+                while head > 0:
+                    plan.append(min(head, self.chunk_tokens))
+                    head -= plan[-1]
+                plan += [int(c) for c in req.kv_history]
+                tot, trimmed = 0, []
+                for c in plan:
+                    c = min(c, Tp - tot)
+                    if c <= 0:
+                        break
+                    trimmed.append(c)
+                    tot += c
+                trimmed += [1] * (Tp - tot)
+                req.kv_attach = 0
+                req.kv_history = list(trimmed)
+                self._replay[slot] = deque(trimmed)
+            else:
+                # fresh admission: nothing emitted yet, so the schedule
+                # is free — reset the recording (a pre-first-token
+                # rollback may have recorded chunks it then discarded)
+                req.kv_history = []
+                req.kv_attach = int(offset)
+                self._replay[slot] = None
         self._base[slot] = base
         self._lengths[slot] = offset
         self._cur_tok[slot] = 0
@@ -1754,6 +1927,7 @@ class ServingEngine:
         W, impl = self._width, self.attn_impl
         spec = self.speculative
         S = self.spec_tokens
+        quant = self._quant
 
         def unified(param_arrays, kp, vp, table, lock, lengths, cur_tok,
                     done, remaining, counters, seeds, temp, top_k,
@@ -1761,13 +1935,16 @@ class ServingEngine:
                     decode_mask, *rest):
             if spec:
                 drafts, n_draft, *rest = rest
+            if quant:
+                ks, vs, *rest = rest
             adapter = tuple(rest)
             saved = [p._data for p in params]
             _trace_channel.push_frame()
             prev_ctx = None
             if adapter:
-                aslot, a_A, a_B, a_scale = adapter
-                prev_ctx = _set_adapter_ctx((a_A, a_B, a_scale, aslot))
+                aslot, a_A, a_B, a_scale, *a_qs = adapter
+                prev_ctx = _set_adapter_ctx(
+                    (a_A, a_B, a_scale, aslot) + tuple(a_qs))
             try:
                 for p, d in zip(params, param_arrays):
                     arr = NDArray(d)
@@ -1783,9 +1960,15 @@ class ServingEngine:
                 else:
                     qn = jnp.where(prefilling, chunk_len,
                                    jnp.where(active, 1, 0))
-                cache = PagedKVCache(kp, vp, table, lengths,
-                                     page_lock=lock, spans=qn,
-                                     attn_impl=impl)
+                if quant:
+                    cache = PagedKVCache(kp, vp, table, lengths,
+                                         page_lock=lock, spans=qn,
+                                         k_scale=ks, v_scale=vs,
+                                         attn_impl=impl)
+                else:
+                    cache = PagedKVCache(kp, vp, table, lengths,
+                                         page_lock=lock, spans=qn,
+                                         attn_impl=impl)
                 logits, cache = model.forward(NDArray(toks_in), cache)
                 lg = logits._data
                 pos = jnp.arange(W)[None, :]
@@ -1868,11 +2051,19 @@ class ServingEngine:
                 _trace_channel.pop_frame()
                 for p, d in zip(params, saved):
                     p._data = d
-            return (cache.k_pages, cache.v_pages, new_len, new_cur,
-                    new_done, new_rem, new_cnt, ok, toks, n_em,
-                    n_acc_em)
+            out = (cache.k_pages, cache.v_pages, new_len, new_cur,
+                   new_done, new_rem, new_cnt, ok, toks, n_em,
+                   n_acc_em)
+            if quant:
+                out = out + (cache.k_scale, cache.v_scale)
+            return out
 
-        return jax.jit(unified, donate_argnums=(1, 2))
+        # the scale pools are state like kp/vp: donated through every
+        # dispatch (positions 20/21, or 22/23 after the spec operands)
+        donate = (1, 2)
+        if quant:
+            donate += (22, 23) if spec else (20, 21)
+        return jax.jit(unified, donate_argnums=donate)
 
     def _dispatch(self):
         """ONE unified dispatch: assemble the per-slot work rows
@@ -1901,9 +2092,31 @@ class ServingEngine:
                            key=lambda s: (s - self._chunk_rr) % B):
             pend = self._pending[slot]
             if pend is not None and pend.size:
-                n = min(int(pend.size), self.chunk_tokens, budget)
-                if n <= 0:
-                    continue        # budget spent: the chunk waits
+                rq = self._replay[slot]
+                if rq:
+                    # quantized restart: feed the recorded chunk size
+                    # exactly — splitting it would re-quantize deep
+                    # layers under different scale views and break
+                    # continuation bit-identity. A chunk the current
+                    # dispatch budget can't cover waits for a fresh
+                    # budget; only one that can NEVER fit is split.
+                    want = min(int(rq[0]), self.chunk_tokens)
+                    if want > budget and want <= self.prefill_chunk_budget:
+                        continue
+                    n = min(want, budget)
+                    if n <= 0:
+                        continue
+                    if n >= int(rq[0]):
+                        rq.popleft()
+                    else:
+                        rq[0] = int(rq[0]) - n
+                else:
+                    n = min(int(pend.size), self.chunk_tokens, budget)
+                    if n <= 0:
+                        continue    # budget spent: the chunk waits
+                    if self._quant:
+                        self.scheduler.request_at(slot) \
+                            .kv_history.append(n)
                 budget -= n
                 toks_in[slot, :n] = pend[:n]
                 chunk_len[slot] = n
@@ -1925,6 +2138,8 @@ class ServingEngine:
         tail, table = st[11:-1], st[-1]   # (aslot,) with the pool on
         extra = (jnp.asarray(drafts), jnp.asarray(n_draft)) \
             if spec else ()
+        if self._quant:
+            extra = extra + (self._ks, self._vs)
         t0 = self._clock()
         with span("serving.dispatch", engine=self._eid,
                   active=len(active_slots),
@@ -1937,8 +2152,13 @@ class ServingEngine:
                 jnp.asarray(toks_in), jnp.asarray(chunk_len),
                 jnp.asarray(is_final), jnp.asarray(decode_mask),
                 *extra, *self._adapter_args(tail))
-            (self._kp, self._vp, lengths, cur_tok, done, remaining,
-             counters, okc, toks, n_em, n_acc) = out
+            if self._quant:
+                (self._kp, self._vp, lengths, cur_tok, done, remaining,
+                 counters, okc, toks, n_em, n_acc,
+                 self._ks, self._vs) = out
+            else:
+                (self._kp, self._vp, lengths, cur_tok, done, remaining,
+                 counters, okc, toks, n_em, n_acc) = out
             self._dstate = (lengths, cur_tok, done, remaining, counters,
                             seeds, temp, top_k, top_p, do_sample,
                             eos) + tail + (table,)
@@ -1990,6 +2210,7 @@ class ServingEngine:
                 # final chunk: the request's first token landed in the
                 # same dispatch — the slot decodes from the next tick
                 self._pending[slot] = None
+                self._replay[slot] = None
                 first = int(toks[slot, 0])
                 req.output_tokens.append(first)
                 req.token_times.append(now)
@@ -2138,6 +2359,7 @@ class ServingEngine:
         req = self.scheduler.release(slot)
         req.t_finish = self._clock()
         self._pending[slot] = None
+        self._replay[slot] = None
         self._done[slot] = True
         self._remaining[slot] = 0
         self._lengths[slot] = self.max_length

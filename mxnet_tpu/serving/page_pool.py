@@ -51,13 +51,35 @@ class PagePoolExhausted(MXNetError):
 class PagePool:
     """Host-side ref-counted allocator over a pool of physical KV pages."""
 
-    def __init__(self, num_pages):
+    def __init__(self, num_pages, page_bytes=None):
         if num_pages < 1:
             raise MXNetError("PagePool needs at least one page")
         self.num_pages = int(num_pages)
+        # optional bytes per page (KV slabs + dequant scales) — set by
+        # from_bytes / the engine so capacity introspection can report
+        # the pool in HBM terms
+        self.page_bytes = int(page_bytes) if page_bytes else None
         self._refcount = np.zeros(self.num_pages, np.int32)
         self._allocated = np.zeros(self.num_pages, bool)
         self._free = deque(range(self.num_pages))
+
+    @classmethod
+    def from_bytes(cls, hbm_budget_bytes, page_bytes):
+        """Byte-denominated sizing: as many whole pages as the HBM
+        budget affords at ``page_bytes`` per page (one page's k+v slabs
+        across all layers, plus the per-page dequant scales when the
+        pools are quantized). Storing pages at int8 instead of fp32
+        shrinks ``page_bytes`` ~4× — the freed budget comes back as
+        MORE PAGES, i.e. real admitted-slot capacity, with no caller
+        arithmetic."""
+        if page_bytes < 1:
+            raise MXNetError("from_bytes needs page_bytes >= 1")
+        n = int(hbm_budget_bytes) // int(page_bytes)
+        if n < 1:
+            raise MXNetError(
+                f"hbm_budget_bytes {int(hbm_budget_bytes)} below one "
+                f"page ({int(page_bytes)} bytes)")
+        return cls(n, page_bytes=page_bytes)
 
     # -- queries -----------------------------------------------------------
     @property
@@ -173,7 +195,8 @@ class PagePool:
         return dst, True
 
     @thread_safe
-    def audit(self, leases=None, members=(), raise_on_error=False):
+    def audit(self, leases=None, members=(), raise_on_error=False,
+              scales=None):
         """O(pages) invariant check — the supervisor runs this after
         every caught dispatch fault, and tests run it at drain.
 
@@ -184,11 +207,27 @@ class PagePool:
         allocated page, and an allocated page with refcount 0 must be
         a tree member — anything else is a leaked page.
         members: page ids the prefix-cache radix tree owns.
+        scales: optional (num_pages,) per-page quantization-scale
+        summary (max |scale| over layers/heads, host-side) for int8
+        pools. Scale leaves must stay lease-consistent: one entry per
+        pool page, finite and non-negative everywhere — a NaN/inf or
+        negative scale is corrupted quantization state that would
+        silently poison every future read of that page.
 
         Returns the list of violation strings ([] = clean); with
         raise_on_error=True a non-empty list raises MXNetError instead.
         """
         v = []
+        if scales is not None:
+            scales = np.asarray(scales)
+            if scales.shape != (self.num_pages,):
+                v.append(f"scale leaf covers {scales.shape} pages, pool "
+                         f"has {self.num_pages}")
+            else:
+                bad = ~np.isfinite(scales) | (scales < 0)
+                for p in np.nonzero(bad)[0]:
+                    v.append(f"page {int(p)}: corrupt quant scale "
+                             f"{float(scales[p])!r}")
         free = list(self._free)
         free_set = set(free)
         if len(free) != len(free_set):

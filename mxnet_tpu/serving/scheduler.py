@@ -118,6 +118,14 @@ class Request:
         self.dispatch_failures = 0
         self.t_not_before = 0.0
         self._seq = None             # global submit order, set by submit()
+        # quantized-KV write schedule (serving/engine.py): the prefill
+        # chunk sizes actually fed, plus any prefix-cache tokens
+        # attached instead of computed. Per-page dequant scales make
+        # deep-layer KV codes depend on chunk boundaries, so a restart
+        # or migration can only continue bit-identically by REPLAYING
+        # this schedule; it rides the Request through export/adopt.
+        self.kv_history = []
+        self.kv_attach = 0
         # subscriber slot (serving/frontend.py): anything with
         # emit(tokens)->bool / close(status). The engine feeds it as
         # tokens land and closes it at every terminal transition; it

@@ -17,7 +17,12 @@ Accepted file shapes (auto-detected per file):
 
 Direction is inferred from the metric/unit name: `*latency*`, `*_ms`,
 `*seconds*`, `*bytes*`, `*loss*` are lower-is-better; everything else
-(tokens/sec, img/sec, MFU fractions) is higher-is-better.
+(tokens/sec, img/sec, MFU fractions) is higher-is-better. Capacity
+metrics (`goodput`, `admitted_slots`, `admitted_pages`, ...) are
+EXPLICITLY higher-is-better and win over any lower-is-better token
+that happens to share the name — a dotted extras path like
+`capacity_at_bytes.admitted_pages` must not flip direction just
+because `bytes` appears in it.
 
 Usage:
     python tools/bench_compare.py OLD.json NEW.json [MORE.json ...]
@@ -45,9 +50,17 @@ _LOWER_BETTER = ("latency", "_ms", "seconds", "bytes", "loss",
                  "eviction", "compiles", "shed", "pending", "makespan",
                  "stall", "disconnect")
 
+# capacity/throughput names where MORE is the win — checked FIRST so a
+# lower-is-better token sharing the name (e.g. `bytes` inside
+# `capacity_at_bytes.admitted_pages`) can't flip the direction
+_HIGHER_BETTER = ("goodput", "admitted_slots", "admitted_pages",
+                  "tokens_per_s", "throughput", "capacity")
+
 
 def lower_is_better(name):
     low = str(name).lower()
+    if any(t in low for t in _HIGHER_BETTER):
+        return False
     return any(t in low for t in _LOWER_BETTER)
 
 

@@ -452,6 +452,15 @@ def main():
                   + f"compiles {s['compiles']} "
                   f"({s['compile_seconds']:.2f}s), "
                   f"dispatches {s['dispatches']} (avg {avg:.2f} ms)")
+        if eng is not None:
+            # the capacity headline quantized pages move: HBM per
+            # generated token, next to the ledger that accounts it
+            s = eng.stats
+            print(f"# kv cost: {s['kv_bytes_per_token']:.1f} "
+                  f"bytes/token "
+                  f"({s['kv_page_bytes']} B/page, "
+                  f"kv_dtype {'int8' if s['kv_quant_enabled'] else 'fp'}"
+                  f", quant {'on' if s['kv_quant_enabled'] else 'off'})")
         led = telemetry.ledger.snapshot()
         live = led.get("live_array_bytes")
         unattr = led.get("unattributed_bytes")

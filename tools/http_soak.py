@@ -162,6 +162,11 @@ def main(argv=None):
     ap.add_argument("--stream-buffer", type=int, default=16,
                     help="per-stream token buffer — small, so slow "
                          "readers genuinely overflow")
+    ap.add_argument("--kv-dtype", default="float32",
+                    choices=("float32", "int8"),
+                    help="KV page storage: int8 runs the whole soak — "
+                         "chaos, kill-migration, bit-identity bar — "
+                         "through quantized pages with fused dequant")
     ap.add_argument("--json", default=None,
                     help="also write the summary JSON to this path")
     args = ap.parse_args(argv)
@@ -217,9 +222,17 @@ def main(argv=None):
         bodies.append(body)
 
     def new_engine(max_queue=None):
+        kv = None if args.kv_dtype == "float32" else args.kv_dtype
+        # int8 pages: the chunk grid is part of the numerics, so the
+        # bit-identity bar needs a non-binding prefill budget — every
+        # prompt then chunks on the same grid in the reference engine,
+        # the replicas, and a migration replay (docs/SERVING.md
+        # "Quantized KV pages")
+        budget = slots * page if kv else None
         eng = ServingEngine(net, num_slots=slots, max_length=max_len,
                             page_size=page, decode_block=block,
-                            attn_impl="xla", max_queue=max_queue)
+                            attn_impl="xla", max_queue=max_queue,
+                            kv_dtype=kv, prefill_chunk_budget=budget)
         # warm every prefill bucket a migrated request can land in
         # (re-prefill covers prompt + already-emitted tokens)
         eng.serve([Request(list(range(1, b + 1)), 2,
