@@ -1945,6 +1945,174 @@ def bench_gpt2_serving_quantkv():
     return 0 if ok else 1
 
 
+def bench_gpt2_serving_tp():
+    """Tensor-parallel serving A/B: the SAME Poisson stream served by
+    a tp=1 engine and a tp=N engine (head-wise shard_map over the
+    serving tp mesh; docs/SERVING.md "Tensor-parallel serving"), on a
+    forced multi-device CPU mesh when no real mesh is present (main()
+    injects --xla_force_host_platform_device_count for this workload).
+    The headline is tokens/sec/CHIP — goodput divided by shard count,
+    the number that transfers to a real mesh. On the CPU lane shards
+    time-slice the same host cores, so this round is a correctness
+    harness, not a speedup claim: the gates are the contract, not the
+    ratio. Pass criteria: ZERO greedy token mismatches tp=N vs tp=1
+    (the committed bit-exactness contract — per-head math is
+    head-independent and the single psum per projection reassembles
+    identical logits up to ~1e-6 reassociation noise, which greedy
+    argmax must not see on these streams), zero steady-state compiles
+    in BOTH engines (shard count is a construction-time mode, never a
+    shape axis — a tp=N engine owns the same two programs a tp=1
+    engine does), clean page audits, every request finished, and the
+    /statusz sharding block reporting the expected shard count.
+    Sampled requests ride the same stream; their exact-match rate is
+    reported (not gated: the Gumbel comparison may flip a near-tie on
+    the reassociation noise, by design). vs_baseline is the per-chip
+    goodput ratio tp=N / tp=1 (< 1 on CPU by construction)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import GPT2ForCausalLM, gpt2_774m_config
+    from mxnet_tpu.serving import Request, ServingEngine
+
+    tp_n = int(os.environ.get("BENCH_TP", 2))
+    if len(jax.devices()) < tp_n:
+        _emit("gpt2_serving_tp_tokens_per_sec_per_chip", 0.0,
+              "tokens/sec/chip", 0.0,
+              error=f"need {tp_n} devices, have {len(jax.devices())}; "
+                    "set XLA_FLAGS=--xla_force_host_platform_device_"
+                    f"count={tp_n}")
+        return 1
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8 if on_tpu else 4))
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS",
+                                    32 if on_tpu else 20))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", 0))  # req/s; 0=open
+    cfg = gpt2_774m_config(dtype="bfloat16" if on_tpu else "float32",
+                           dropout=0.0, attention_dropout=0.0)
+    max_len, page = 1024, 64
+    p_lo, p_hi, o_lo, o_hi = 16, 128, 32, 96
+    if not on_tpu:  # CPU smoke config
+        cfg.vocab_size, cfg.units, cfg.hidden_size = 512, 256, 1024
+        cfg.num_layers, cfg.num_heads, cfg.max_length = 2, 4, 128
+        max_len, page = 128, 8
+        p_lo, p_hi, o_lo, o_hi = 2, 12, 4, 12
+
+    net = GPT2ForCausalLM(cfg)
+    net.initialize(mx.init.Normal(0.02))
+    if on_tpu:
+        net.cast("bfloat16")
+
+    def mk_requests(n, id0):
+        rng = np.random.default_rng(23)
+        out = []
+        for i in range(n):
+            out.append(Request(
+                rng.integers(0, cfg.vocab_size,
+                             int(rng.integers(p_lo, p_hi + 1))).tolist(),
+                int(rng.integers(o_lo, o_hi + 1)),
+                do_sample=bool(i % 2), temperature=0.8, top_k=40,
+                seed=i, request_id=id0 + i))
+        return out
+
+    def run_config(tag, tp):
+        # both configs pin the same chunk grid — the comparison varies
+        # shard count and nothing else
+        eng = ServingEngine(net, num_slots=slots, max_length=max_len,
+                            page_size=page, chunk_tokens=page,
+                            prefill_chunk_budget=slots * page, tp=tp)
+        eng.serve([Request(list(range(1, page + 1)), 2,
+                           request_id=f"{tag}-warm-greedy")])
+        eng.serve([Request(list(range(1, page + 1)), 2, do_sample=True,
+                           seed=0, request_id=f"{tag}-warm-sampled")])
+        eng.mark_warm()
+        c0 = _engine_compiles(eng._eid)
+        eng.reset_stats()
+
+        reqs = mk_requests(n_requests, id0=1000)
+        rng = np.random.default_rng(13)
+        gaps = rng.exponential(1.0 / rate, n_requests) if rate > 0 \
+            else np.zeros(n_requests)
+        arrivals = np.cumsum(gaps)
+        t0 = time.perf_counter()
+        pending = list(zip(arrivals, reqs))
+        while pending or eng.has_work:
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                eng.submit(pending.pop(0)[1])
+            if eng.has_work:
+                eng.step()
+            elif pending:
+                time.sleep(min(pending[0][0] - now, 0.01))
+        dt = time.perf_counter() - t0
+
+        fin = [r for r in reqs if r.status == "finished"]
+        tokens = sum(len(r.output_tokens) for r in fin)
+        goodput = tokens / dt
+        return {
+            "tp": tp,
+            "goodput_tokens_per_sec": round(goodput, 2),
+            "tokens_per_sec_per_chip": round(goodput / tp, 2),
+            "makespan_s": round(dt, 3),
+            "finished": len(fin), "requests": n_requests,
+            "steady_state_compiles": _engine_compiles(eng._eid) - c0,
+            "audit_leaks": len(eng.audit_pages()),
+            "sharding": eng._statusz()["sharding"],
+            "tp_shards_gauge": eng.stats["tp_shards"],
+            "outputs": {r.id: (bool(r.do_sample), list(r.output_tokens))
+                        for r in reqs},
+            "device_cost": _device_cost_extras(eng._eid),
+        }
+
+    base = run_config("tp1", 1)
+    shard = run_config(f"tp{tp_n}", tp_n)
+
+    out_b, out_s = base.pop("outputs"), shard.pop("outputs")
+    g_mismatch = g_total = s_exact = s_total = 0
+    for rid, (sampled, toks_b) in out_b.items():
+        toks_s = out_s[rid][1]
+        if sampled:
+            s_total += 1
+            s_exact += int(toks_b == toks_s)
+        else:
+            g_total += 1
+            g_mismatch += int(toks_b != toks_s)
+
+    per_chip_ratio = round(shard["tokens_per_sec_per_chip"]
+                           / max(base["tokens_per_sec_per_chip"],
+                                 1e-9), 3)
+    extras = {
+        "tp": tp_n,
+        "greedy_mismatches": g_mismatch,
+        "greedy_streams": g_total,
+        "sampled_exact": f"{s_exact}/{s_total}",
+        "tp1": base, f"tp{tp_n}": shard,
+        "slots": slots,
+        "prompt_lens": f"U[{p_lo},{p_hi}]",
+        "output_lens": f"U[{o_lo},{o_hi}]",
+        "arrivals": "open-loop" if rate == 0 else f"poisson({rate}/s)",
+        "params": cfg.num_params(),
+        "device": str(dev.device_kind),
+        "devices": len(jax.devices()),
+        "baseline": "the same stream on a tp=1 engine, per-chip "
+                    "(CPU shards time-slice one host: correctness "
+                    "lane, not a speedup claim)",
+    }
+    _emit("gpt2_serving_tp_tokens_per_sec_per_chip",
+          shard["tokens_per_sec_per_chip"], "tokens/sec/chip",
+          per_chip_ratio, extras=extras)
+    _emit("gpt2_serving_tp_greedy_mismatches", g_mismatch, "tokens",
+          0.0, extras={"greedy_streams": g_total, "tp": tp_n})
+    ok = (g_mismatch == 0
+          and base["steady_state_compiles"] == 0
+          and shard["steady_state_compiles"] == 0
+          and not base["audit_leaks"] and not shard["audit_leaks"]
+          and base["finished"] == n_requests
+          and shard["finished"] == n_requests
+          and shard["sharding"]["tp_shards"] == tp_n
+          and base["sharding"] is None)
+    return 0 if ok else 1
+
+
 def bench_gpt2_serving_http():
     """HTTP ingress overhead + robustness: the SAME greedy Poisson
     stream served (A) in-process — requests submitted straight into a
@@ -2307,6 +2475,21 @@ def bench_decode():
 
 
 def main():
+    workload = os.environ.get("BENCH_WORKLOAD", "both")
+    if "--workload" in sys.argv:
+        workload = sys.argv[sys.argv.index("--workload") + 1]
+    if (workload in ("serving_tp", "tp", "tensor_parallel",
+                     "gpt2_serving_tp")
+            and "jax" not in sys.modules
+            and "host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        # the tp A/B needs a multi-device mesh; on a CPU host that
+        # means forcing virtual devices BEFORE jax initialises (the
+        # flag only affects the host platform — harmless on TPU)
+        n = max(int(os.environ.get("BENCH_TP", 2)), 2)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}")
     import jax
     # rbg (hardware RNG) for dropout masks: threefry mask generation costs
     # ~35% of step time on TPU; rbg is the standard TPU training choice
@@ -2315,9 +2498,6 @@ def main():
             jax.config.update("jax_default_prng_impl", "rbg")
         except Exception:
             pass
-    workload = os.environ.get("BENCH_WORKLOAD", "both")
-    if "--workload" in sys.argv:
-        workload = sys.argv[sys.argv.index("--workload") + 1]
     if workload == "both":
         # resnet first, BERT LAST — the driver tail-parses the last line
         # and must keep getting the north-star metric
@@ -2370,6 +2550,9 @@ def main():
     if workload in ("serving_quantkv", "quantkv", "int8_kv",
                     "gpt2_serving_quantkv"):
         return bench_gpt2_serving_quantkv()
+    if workload in ("serving_tp", "tp", "tensor_parallel",
+                    "gpt2_serving_tp"):
+        return bench_gpt2_serving_tp()
     if workload in ("serving_http", "http", "frontend",
                     "gpt2_serving_http"):
         return bench_gpt2_serving_http()

@@ -114,7 +114,11 @@ def traced_functions(tree):
         elif isinstance(node, ast.Call):
             fname = terminal_name(node.func)
             is_jit = _is_jit_ref(node.func)
-            if not (is_jit or fname == "CostedFunction"):
+            # shard_map bodies trace exactly like jit bodies (the
+            # serving tp dispatch wraps its program this way before
+            # the outer jit), so they get the same discipline
+            if not (is_jit or fname in ("CostedFunction", "shard_map",
+                                        "shard_map_compat")):
                 continue
             if node.args and isinstance(node.args[0], ast.Name):
                 for fn in by_name.get(node.args[0].id, ()):
@@ -158,10 +162,20 @@ class _TraceChecker:
         return any(self.tainted(c) for c in ast.iter_child_nodes(node))
 
     def _branch_static(self, test):
-        """True when a tainted test is actually a static length check:
-        a bare (possibly negated) container-of-traced name."""
+        """True when a tainted test is actually trace-static: a bare
+        (possibly negated) container-of-traced name, or an identity
+        check against None — `x is None` reads the PYTHON identity of
+        the tracer object, never its value, so branching on it is an
+        ordinary trace-time mode switch (the mask-optional shard_map
+        bodies in parallel/sp.py rely on this)."""
         if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
             return self._branch_static(test.operand)
+        if isinstance(test, ast.Compare) \
+                and all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in test.ops) \
+                and any(isinstance(c, ast.Constant) and c.value is None
+                        for c in [test.left] + list(test.comparators)):
+            return True
         return isinstance(test, ast.Name) and test.id in self.containers
 
     def _flag(self, rule, node, message):
@@ -216,6 +230,21 @@ class _TraceChecker:
                     and len(target.elts) == 2:
                 self._bind(target.elts[1], self.tainted(iter_node))
                 return
+        if isinstance(iter_node, ast.Call) \
+                and isinstance(iter_node.func, ast.Name) \
+                and iter_node.func.id == "zip" \
+                and not iter_node.keywords \
+                and isinstance(target, (ast.Tuple, ast.List)) \
+                and len(target.elts) == len(iter_node.args) \
+                and not any(isinstance(a, ast.Starred)
+                            for a in iter_node.args):
+            # `for a, b in zip(xs, ys)` taints each target from ITS
+            # OWN iterable — a static multiplier list zipped next to
+            # traced params must not smear taint onto the multiplier
+            for elt, arg in zip(target.elts, iter_node.args):
+                self._bind(elt if not isinstance(elt, ast.Starred)
+                           else elt.value, self.tainted(arg))
+            return
         self._bind(target, self.tainted(iter_node))
 
     def _value_is_container(self, value):

@@ -29,7 +29,7 @@ from .kv_cache import KVCache, PagedKVCache
 
 __all__ = ["GPT2Config", "GPT2Model", "GPT2ForCausalLM", "gpt2_small_config",
            "gpt2_medium_config", "gpt2_774m_config", "gpt2_xl_config",
-           "set_adapter_ctx"]
+           "set_adapter_ctx", "set_tp_ctx"]
 
 # -- serving LoRA adapter context -------------------------------------------
 # The serving engine sets this (to TRACED slab arrays) around
@@ -49,6 +49,28 @@ def set_adapter_ctx(ctx):
     global _adapter_ctx
     prev = _adapter_ctx
     _adapter_ctx = ctx
+    return prev
+
+
+# -- serving tensor-parallel context ----------------------------------------
+# The serving engine sets this while tracing its unified dispatch inside
+# a shard_map over the mesh's "tp" axis: (axis_name, size). Under it the
+# forward is the megatron head-wise split — qkv/fc1 run on head-sliced
+# weights unchanged (column parallel), `_split` reshapes to the
+# per-shard head count, and proj/fc2 become row-parallel: a no-bias
+# partial matmul + ONE lax.psum + the (replicated) bias added once.
+# None everywhere outside those traces, where every code path below is
+# byte-identical to the unsharded program.
+_tp_ctx = None
+
+
+def set_tp_ctx(ctx):
+    """Install the serving tensor-parallel context ((axis_name, size)
+    or None); returns the previous value so callers can restore it in a
+    finally block."""
+    global _tp_ctx
+    prev = _tp_ctx
+    _tp_ctx = ctx
     return prev
 
 
@@ -125,6 +147,8 @@ class GPT2Attention(HybridBlock):
     def _split(self, x, bthd=False):
         b, t, _ = x.shape
         h, d = self._num_heads, self._units // self._num_heads
+        if _tp_ctx is not None:
+            h //= _tp_ctx[1]     # per-shard head slice inside shard_map
         x = x.reshape((b, t, h, d))
         return x if bthd else x.transpose((0, 2, 1, 3))
 
@@ -135,9 +159,21 @@ class GPT2Attention(HybridBlock):
         slab slot. No-op (returns y untouched — the compiled program
         is byte-identical to the adapter-free one) outside a serving
         adapter context."""
-        ctx = _adapter_ctx
-        if ctx is None or layer_idx is None:
+        if _adapter_ctx is None or layer_idx is None:
             return y
+        d = self._lora_delta(pidx, layer_idx, x)
+        yd = y._data if isinstance(y, NDArray) else y
+        return NDArray(yd + d)
+
+    def _lora_delta(self, pidx, layer_idx, x):
+        """The low-rank delta itself. Under a serving tp context the
+        slabs enter head-sliced on their U axis (A on in-features for
+        pidx 3, B on out-features for 0..2), so the rank reduction is a
+        per-shard partial summed with ONE psum; for the row-parallel
+        proj (pidx 3) the local out-slice is scattered to its head
+        offset so the CALLER's psum assembles the full-width delta —
+        no collective beyond the one the matmul already pays."""
+        ctx = _adapter_ctx
         # 4-tuple = float slab; 6-tuple = int8 slab with per-(proj,
         # layer, slot) dequant scales appended (serving.AdapterPool
         # quantized mode) — dequant on the gathered slot slices, so HBM
@@ -153,11 +189,47 @@ class GPT2Attention(HybridBlock):
             sb = jnp.take(bsc[pidx, layer_idx], slots, axis=0)
             ag = ag.astype(jnp.float32) * sa[:, None, None]
             bg = bg.astype(jnp.float32) * sb[:, None, None]
-        d = jnp.einsum("btu,bur->btr", xd.astype(ag.dtype), ag)
-        d = jnp.einsum("btr,bru->btu", d, bg)
+        tp = _tp_ctx
+        if tp is None:
+            d = jnp.einsum("btu,bur->btr", xd.astype(ag.dtype), ag)
+            d = jnp.einsum("btr,bru->btu", d, bg)
+            return (d.astype(jnp.float32)
+                    * s[:, None, None]).astype(xd.dtype)
+        axis, size = tp
+        u_loc = ag.shape[1]
+        i = jax.lax.axis_index(axis)
+        if pidx == 3:
+            xs = xd          # proj input is already the local head slice
+        else:
+            # qkv deltas contract the REPLICATED residual against the
+            # local U-rows of A: slice x to match
+            xs = jax.lax.dynamic_slice_in_dim(xd, i * u_loc, u_loc, 2)
+        r = jax.lax.psum(
+            jnp.einsum("btu,bur->btr", xs.astype(ag.dtype), ag), axis)
+        d = jnp.einsum("btr,bru->btu", r, bg)
         d = (d.astype(jnp.float32) * s[:, None, None]).astype(xd.dtype)
-        yd = y._data if isinstance(y, NDArray) else y
-        return NDArray(yd + d)
+        if pidx == 3:
+            full = jnp.zeros(d.shape[:2] + (u_loc * size,), d.dtype)
+            d = jax.lax.dynamic_update_slice_in_dim(full, d, i * u_loc, 2)
+        return d
+
+    def _proj_out(self, out, layer_idx):
+        """proj(out) + LoRA delta. Under a serving tp context `out` is
+        the local head slice and proj is row-parallel: a no-bias partial
+        matmul plus the scattered LoRA partial, ONE psum assembling
+        both, the (replicated) bias added once after."""
+        tp = _tp_ctx
+        if tp is None:
+            return self._lora(self.proj(out), 3, layer_idx, out)
+        part = _opnn.FullyConnected(out, self.proj.weight.data(), None,
+                                    no_bias=True, flatten=False)
+        part = part._data if isinstance(part, NDArray) else part
+        if _adapter_ctx is not None and layer_idx is not None:
+            part = part + self._lora_delta(3, layer_idx, out)
+        full = jax.lax.psum(part, tp[0])
+        if self.proj.bias is not None:
+            full = full + self.proj.bias.data()._data
+        return NDArray(full)
 
     def forward(self, x, cache=None, layer_idx=None):
         if cache is None:
@@ -175,7 +247,7 @@ class GPT2Attention(HybridBlock):
                 impl=self._impl, layout="BTHD")
             b, t, h, d = out.shape
             out = out.reshape((b, t, h * d))
-            return self._lora(self.proj(out), 3, layer_idx, out), cache
+            return self._proj_out(out, layer_idx), cache
         # static-cache path (inference): write this chunk at position
         # cache.length, attend over the full buffer under a validity ×
         # causal mask. The chunk is either the whole prompt (prefill)
@@ -231,7 +303,7 @@ class GPT2Attention(HybridBlock):
                 b, tq, h, d = out.shape
                 out = out.astype(q._data.dtype).reshape(b, tq, h * d)
             out = NDArray(out)
-            return self._lora(self.proj(out), 3, layer_idx, out), cache
+            return self._proj_out(out, layer_idx), cache
         if t > 1:
             k_all, v_all, cache = cache.write_prompt(
                 layer_idx, k._data, v._data)
@@ -249,7 +321,7 @@ class GPT2Attention(HybridBlock):
             impl="xla" if self._impl == "ring" else self._impl)
         b, h, t, d = out.shape
         out = out.transpose((0, 2, 1, 3)).reshape((b, t, h * d))
-        return self._lora(self.proj(out), 3, layer_idx, out), cache
+        return self._proj_out(out, layer_idx), cache
 
 
 class GPT2Block(HybridBlock):
@@ -268,6 +340,21 @@ class GPT2Block(HybridBlock):
         self._activation = c.activation
         self.dropout = Dropout(c.dropout) if c.dropout else None
 
+    def _fc2_out(self, h):
+        """fc2(h). Under a serving tp context fc1 was column-parallel
+        (h is the local hidden slice), so fc2 is row-parallel: no-bias
+        partial matmul, ONE psum, the replicated bias added once."""
+        tp = _tp_ctx
+        if tp is None:
+            return self.fc2(h)
+        part = _opnn.FullyConnected(h, self.fc2.weight.data(), None,
+                                    no_bias=True, flatten=False)
+        part = part._data if isinstance(part, NDArray) else part
+        full = jax.lax.psum(part, tp[0])
+        if self.fc2.bias is not None:
+            full = full + self.fc2.bias.data()._data
+        return NDArray(full)
+
     def forward(self, x, cache=None, layer_idx=None):
         h, cache = self.attn(self.ln1(x), cache, layer_idx)
         if self.dropout is not None:
@@ -275,7 +362,7 @@ class GPT2Block(HybridBlock):
         x = x + h
         h = _opnn.Activation(self.fc1(self.ln2(x)),
                              act_type=self._activation)
-        h = self.fc2(h)
+        h = self._fc2_out(h)
         if self.dropout is not None:
             h = self.dropout(h)
         return x + h, cache

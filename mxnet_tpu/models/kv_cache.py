@@ -117,7 +117,16 @@ class PagedKVCache:
     after they were written; the tolerance oracle bounds this). Dequant
     happens where the page bytes are touched — fused into the ragged
     Pallas kernel's page DMA (ops/pallas_attention) or on the gathered
-    view for the lockstep path — so HBM traffic stays int8."""
+    view for the lockstep path — so HBM traffic stays int8.
+
+    TENSOR-PARALLEL serving (ServingEngine(tp=N)): every method here is
+    already head-count-agnostic, so inside the engine's shard_map the
+    SAME code runs on per-shard pool slices — k/v pools sharded on the
+    head axis (axis 3) and int8 scale leaves on theirs (axis 2), while
+    page_table / length / spans / page_lock stay replicated so every
+    shard computes identical page geometry. Nothing in this file
+    branches on the shard; the split is purely the caller's sharding of
+    the pool leaves."""
 
     def __init__(self, k_pages, v_pages, page_table, length,
                  page_lock=None, spans=None, k_scale=None, v_scale=None,

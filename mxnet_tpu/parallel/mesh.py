@@ -29,7 +29,7 @@ from ..base import MXNetError
 
 __all__ = ["Mesh", "PartitionSpec", "NamedSharding", "make_mesh",
            "current_mesh", "mesh_scope", "set_default_mesh", "named_sharding",
-           "shard_map_compat",
+           "shard_map_compat", "axis_enabled", "serving_tp_mesh",
            "AXIS_DP", "AXIS_TP", "AXIS_PP", "AXIS_SP", "AXIS_EP", "AXIS_FSDP"]
 
 
@@ -113,6 +113,38 @@ def mesh_scope(mesh):
         yield mesh
     finally:
         _state.stack.pop()
+
+
+def axis_enabled(mesh=None, axis=AXIS_TP):
+    """True iff an active (or given) mesh has a real (size > 1) named
+    axis. Shared predicate for every lane that degrades to the unsharded
+    path when its axis is absent or trivial (sp ring attention, serving
+    tensor parallelism)."""
+    mesh = mesh if mesh is not None else current_mesh()
+    return (mesh is not None and axis in mesh.axis_names
+            and mesh.shape[axis] > 1)
+
+
+def serving_tp_mesh(tp, devices=None):
+    """One-axis {AXIS_TP} mesh over the first `tp` local devices.
+
+    The serving engine's tensor-parallel mode is a compile-time choice:
+    the mesh shape is fixed at engine construction and never appears as
+    a runtime axis, so shard count changes recompile (by design) and
+    steady state stays compile-flat. Returns None for tp == 1 — the
+    unsharded engine path takes no mesh at all."""
+    tp = int(tp)
+    if tp < 1:
+        raise MXNetError(f"tp must be >= 1, got {tp}")
+    if tp == 1:
+        return None
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if tp > len(devices):
+        raise MXNetError(
+            f"serving tp={tp} needs {tp} devices, have {len(devices)} "
+            "(on CPU, force more with "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+    return make_mesh({AXIS_TP: tp}, devices=devices[:tp])
 
 
 def named_sharding(spec, mesh=None):

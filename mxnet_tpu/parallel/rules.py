@@ -15,7 +15,7 @@ from ..base import MXNetError
 from .mesh import PartitionSpec
 
 __all__ = ["ShardingRules", "apply_sharding_rules", "megatron_dense_rules",
-           "fsdp_rules", "ep_rules"]
+           "serving_tp_rules", "fsdp_rules", "ep_rules"]
 
 
 class ShardingRules:
@@ -81,6 +81,28 @@ def megatron_dense_rules(tp_axis="tp", fsdp_axis=None):
     rules.add(r"embed\w*\.weight$", PartitionSpec(tp_axis, fsdp_axis))
     if fsdp_axis is not None:
         rules.default = None  # leave rest replicated; fsdp via explicit specs
+    return rules
+
+
+def serving_tp_rules(tp_axis="tp"):
+    """Head-wise tensor parallelism for the serving lane.
+
+    The megatron column/row split for qkv + fc1 (out-dim sharded) and
+    proj + fc2 (in-dim sharded), with two serving-specific overrides
+    layered on top via first-match-wins ordering:
+
+    - embeddings (and the tied LM head) stay REPLICATED: the serving
+      dispatch samples in-program from full logits on every shard, so a
+      vocab-sharded embed would cost an extra all-gather per step for a
+      parameter that is small next to the KV pool.
+    - everything unmatched (LayerNorm scales/offsets, row-parallel
+      biases) is replicated — the row-parallel bias is added ONCE after
+      the psum, not per shard.
+    """
+    rules = ShardingRules()
+    rules.add(r"embed\w*\.weight$", PartitionSpec())
+    for pat, spec in megatron_dense_rules(tp_axis):
+        rules.rules.append((pat, spec))
     return rules
 
 

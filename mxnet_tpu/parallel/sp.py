@@ -18,17 +18,14 @@ from jax.sharding import PartitionSpec as P
 
 from ..base import MXNetError
 from ..ops.attention import ring_attention_data
-from .mesh import AXIS_SP, current_mesh, shard_map_compat
+from .mesh import AXIS_SP, axis_enabled, current_mesh, shard_map_compat
 
 __all__ = ["ring_attention", "ulysses_attention", "sp_enabled"]
 
 
 def sp_enabled(mesh=None, sp_axis=AXIS_SP):
     """True iff an active mesh has a real (size > 1) sp axis."""
-    mesh = mesh if mesh is not None else current_mesh()
-    return (mesh is not None and sp_axis in mesh.axis_names
-            and mesh.shape[sp_axis] > 1)
-
+    return axis_enabled(mesh, sp_axis)
 
 
 

@@ -92,6 +92,10 @@ from ..ndarray.ndarray import NDArray
 from ..telemetry import server as _tserver
 from ..telemetry import span
 from ..models.gpt2 import set_adapter_ctx as _set_adapter_ctx
+from ..models.gpt2 import set_tp_ctx as _set_tp_ctx
+from ..parallel.mesh import (AXIS_TP, PartitionSpec, named_sharding,
+                             serving_tp_mesh, shard_map_compat)
+from ..parallel.rules import serving_tp_rules
 from .adapters import AdapterPoolExhausted
 from .page_pool import PagePool, PagePoolExhausted
 from .prefix_cache import PrefixCache
@@ -268,6 +272,11 @@ def _engine_metrics(eid):
             "KV-cache HBM bytes per token position "
             "(kv_page_bytes / page_size) — the capacity headline int8 "
             "pages shrink ~4x", _E),
+        "tp_shards": g(
+            "serving_tp_shards",
+            "tensor-parallel shards the unified dispatch runs across "
+            "(head-wise shard_map over the tp mesh axis; 1 = "
+            "unsharded)", _E),
     }
     _shed_family()                  # registered per-process; children
     _tenant_families()
@@ -378,7 +387,7 @@ class ServingEngine:
                  num_priorities=3, policy=None, max_retries=3,
                  retry_backoff_s=0.02, clock=None, adapter_pool=None,
                  tenant_quotas=None, kv_dtype=None,
-                 hbm_budget_bytes=None):
+                 hbm_budget_bytes=None, tp=1, tp_devices=None):
         self.model = model
         cfg = model.config
         self.num_slots = int(num_slots)
@@ -398,6 +407,29 @@ class ServingEngine:
         self.decode_block = decode_block
         self.prefill_bucket = prefill_bucket
         self.attn_impl = attn_impl
+        # tensor-parallel serving (docs/SERVING.md "Tensor-parallel
+        # serving"): tp > 1 runs the ONE unified program shard_map'ed
+        # over a {tp: N} mesh — qkv/fc1 column-parallel, proj/fc2
+        # row-parallel, KV pages split on the HEAD axis, one psum per
+        # projection reassembling full activations so the in-program
+        # sampler sees full logits on every shard. Shard count is a
+        # construction-time MODE, never a program shape axis: tp=1
+        # builds the exact pre-tp program, and a tp=N engine still owns
+        # at most two compiled programs for its lifetime.
+        self._tp = int(tp or 1)
+        if self._tp < 1:
+            raise MXNetError(f"tp must be >= 1, got {tp}")
+        if self._tp > 1:
+            if cfg.num_heads % self._tp:
+                raise MXNetError(
+                    f"tp={self._tp} must divide num_heads "
+                    f"({cfg.num_heads}) — the KV pool and the qkv/proj "
+                    "weights shard head-wise")
+            if cfg.hidden_size % self._tp:
+                raise MXNetError(
+                    f"tp={self._tp} must divide the FFN hidden size "
+                    f"({cfg.hidden_size}) — fc1/fc2 shard on it")
+        self._mesh = serving_tp_mesh(self._tp, devices=tp_devices)
         self.chunk_tokens = int(chunk_tokens or page_size)
         if self.chunk_tokens < 1:
             raise MXNetError("chunk_tokens must be >= 1")
@@ -444,6 +476,21 @@ class ServingEngine:
         self.audit_extra_leases = []
 
         self._params = list(model.collect_params().values())
+        if self._mesh is not None:
+            # per-param layout from the serving tp rules (embeddings +
+            # LM head replicated, qkv/fc1 column-, proj/fc2 row-
+            # parallel; unmatched leaves replicated). Weights are
+            # placed onto the mesh ONCE and cached by array identity
+            # (_placed) — a dispatch never re-shards them.
+            rules = serving_tp_rules(AXIS_TP)
+            self._param_specs = tuple(
+                rules.spec_for(name) or PartitionSpec()
+                for name in model.collect_params().keys())
+            self._placed = {}
+        else:
+            self._param_specs = None
+            self._placed = None
+        self._slab_cache = None
         B = self.num_slots
         P = self._pages_per_slot = max_length // page_size
         # pool sizing: every slot can always claim a full P exclusive
@@ -482,12 +529,16 @@ class ServingEngine:
         self._hbm_budget = None if hbm_budget_bytes is None \
             else int(hbm_budget_bytes)
         if self._hbm_budget is not None:
-            afford = self._hbm_budget // page_bytes
+            # under tp each CHIP holds 1/tp of every page (the head
+            # axis shards), so the budget — the quantity that actually
+            # OOMs — is per chip and buys tp x the pages
+            chip_page = page_bytes // self._tp
+            afford = self._hbm_budget // chip_page
             if afford < P:
                 raise MXNetError(
                     f"hbm_budget_bytes {self._hbm_budget} affords "
-                    f"{afford} pages at {page_bytes} B/page — below the "
-                    f"{P} pages one full-length slot needs")
+                    f"{afford} pages at {chip_page} B/page/chip — below "
+                    f"the {P} pages one full-length slot needs")
             total_pages = min(total_pages, afford)
         pool_shape = (L, total_pages, page_size, H, Dh)
         self._kp = jnp.zeros(pool_shape, store)
@@ -497,6 +548,19 @@ class ServingEngine:
             self._vs = jnp.zeros((L, total_pages, H), jnp.float32)
         else:
             self._ks = self._vs = None
+        if self._mesh is not None:
+            # the pools LIVE sharded (global shape above, head axis
+            # split over the mesh): every eager page op — scrub, CoW
+            # copy, scale zeroing — follows the input layout, and the
+            # unified dispatch's donation keeps the shards in place
+            kv_sh = named_sharding(self._kv_pspec(), mesh=self._mesh)
+            self._kp = jax.device_put(self._kp, kv_sh)
+            self._vp = jax.device_put(self._vp, kv_sh)
+            if self._quant:
+                sc_sh = named_sharding(self._scale_pspec(),
+                                       mesh=self._mesh)
+                self._ks = jax.device_put(self._ks, sc_sh)
+                self._vs = jax.device_put(self._vs, sc_sh)
         self.page_pool = PagePool(total_pages, page_bytes=page_bytes)
         self.prefix_cache = PrefixCache(self.page_pool, page_size,
                                         budget_pages=extra) \
@@ -597,9 +661,9 @@ class ServingEngine:
                    self._do_sample, self._eos]
         if self.adapter_pool is not None:
             scalars.append(self._aslot)
-        self._dstate = tuple(jnp.asarray(a)
+        self._dstate = tuple(self._rep(jnp.asarray(a))
                              for a in scalars + [self._table_host])
-        self._d_lock = jnp.asarray(self._page_lock_host())
+        self._d_lock = self._rep(jnp.asarray(self._page_lock_host()))
         self._eid = str(next(_engine_ids))
         self._metrics = _engine_metrics(self._eid)
         self._metrics["num_slots"].set(self.num_slots)
@@ -697,6 +761,7 @@ class ServingEngine:
             "kv_page_bytes": int(m["kv_page_bytes"].value),
             "kv_bytes_per_token": float(
                 m["kv_bytes_per_token"].value),
+            "tp_shards": int(m["tp_shards"].value),
         }
 
     def tenant_stats(self):
@@ -716,6 +781,7 @@ class ServingEngine:
         self._metrics["kv_quant_enabled"].set(int(self._quant))
         self._metrics["kv_page_bytes"].set(pb)
         self._metrics["kv_bytes_per_token"].set(pb / self.page_size)
+        self._metrics["tp_shards"].set(self._tp)
 
     def reset_stats(self):
         """Zero this engine's telemetry children (other engines and the
@@ -879,6 +945,19 @@ class ServingEngine:
                 if self.adapter_pool is not None else None,
                 "adapter_max_rank": self.adapter_pool.max_rank
                 if self.adapter_pool is not None else None,
+                "tp_shards": self._tp,
+            },
+            "sharding": None if self._mesh is None else {
+                "tp_shards": self._tp,
+                "mesh_devices": [str(d)
+                                 for d in self._mesh.devices.flat],
+                "heads_per_shard":
+                    self.model.config.num_heads // self._tp,
+                "kv_page_bytes_per_chip":
+                    self.page_pool.page_bytes // self._tp,
+                "replicated": ["embeddings", "lm_head", "layernorm",
+                               "page_table", "page_lock",
+                               "slot_scalars", "logits"],
             },
             "admission_capacity": self.admission_capacity_estimate(),
             "robustness": {
@@ -938,9 +1017,13 @@ class ServingEngine:
         return f"engine{self._eid}/{name}"
 
     def _wrap_program(self, fn, name, cost_scale=1.0):
+        # shards: under SPMD, cost_analysis() reports PER-PARTITION
+        # figures — the cost layer re-multiplies registration to
+        # whole-model and divides the per-chip MFU/bandwidth gauges
         return _cost.CostedFunction(fn, self._program(name),
                                     steady_fn=self._steady_probe,
-                                    cost_scale=cost_scale)
+                                    cost_scale=cost_scale,
+                                    shards=self._tp)
 
     def _account_flops(self, program, wall, wasted_fraction=0.0):
         """Per-dispatch device-cost bookkeeping: attribute the wall to
@@ -1676,7 +1759,7 @@ class ServingEngine:
             vals = vals + (self._aslot[slot],)
         self._dstate = self._upload_fn(self._dstate, np.int32(slot),
                                        vals, self._table_host[slot])
-        self._d_lock = jnp.asarray(self._page_lock_host())
+        self._d_lock = self._rep(jnp.asarray(self._page_lock_host()))
 
     def _adapter_args(self, aslot):
         """The extra dispatch operands when the adapter pool is on: the
@@ -1692,6 +1775,8 @@ class ServingEngine:
         args = (aslot, pool.A, pool.B, pool.scale)
         if pool.quantized:
             args = args + (pool.a_scale, pool.b_scale)
+        if self._mesh is not None:
+            args = (aslot,) + self._placed_slab(args[1:])
         return args
 
     # -- pages -------------------------------------------------------------
@@ -1895,6 +1980,69 @@ class ServingEngine:
     def _pending_tokens(self):
         return sum(int(p.size) for p in self._pending if p is not None)
 
+    # -- tensor parallelism ------------------------------------------------
+    def _kv_pspec(self):
+        """KV pool layout under tp: (L, pages, page, H, Dh) with the
+        HEAD axis split over the mesh. Page structure is replicated, so
+        the page table, the lock mask, and every host-side lease
+        decision are shard-count-independent — prefix sharing, CoW and
+        migration never see the mesh."""
+        return PartitionSpec(None, None, None, AXIS_TP, None)
+
+    def _scale_pspec(self):
+        # int8 dequant scales are per-(layer, page, head): they shard
+        # head-wise alongside the codes they decode
+        return PartitionSpec(None, None, AXIS_TP)
+
+    def _rep(self, arr):
+        """Replicate a freshly-built array onto the tp mesh (identity
+        at tp=1). Every dispatch operand must keep a STABLE layout
+        across calls — an operand flipping between single-device and
+        mesh-replicated would be a new jit cache entry, i.e. a
+        steady-state recompile."""
+        if self._mesh is None:
+            return arr
+        return jax.device_put(
+            arr, named_sharding(PartitionSpec(), mesh=self._mesh))
+
+    def _placed_params(self):
+        """The dispatch's weight operands, placed onto the tp mesh ONCE
+        per array (cached by identity, the source pinned so ids can't
+        be recycled): qkv/fc1 column-sharded, proj/fc2 row-sharded,
+        embeddings and norms replicated. set_data swaps the underlying
+        array and therefore re-places."""
+        datas = tuple(p.data()._data for p in self._params)
+        if self._mesh is None:
+            return datas
+        placed = []
+        for d, spec in zip(datas, self._param_specs):
+            hit = self._placed.get(id(d))
+            if hit is None:
+                hit = (d, jax.device_put(
+                    d, named_sharding(spec, mesh=self._mesh)))
+                self._placed[id(d)] = hit
+            placed.append(hit[1])
+        return tuple(placed)
+
+    def _placed_slab(self, arrs):
+        """Mesh placement for the adapter slab leaves (A sharded on its
+        input/U axis, B on its output axis — the SAME head-aligned
+        split as the base weights, so the per-shard LoRA delta lands in
+        the projection's psum; scales replicated). Cached by identity
+        and replaced wholesale when a page-in swaps the slab."""
+        key = tuple(map(id, arrs))
+        cache = self._slab_cache
+        if cache is not None and cache[0] == key:
+            return cache[2]
+        specs = [PartitionSpec(None, None, None, AXIS_TP, None),
+                 PartitionSpec(None, None, None, None, AXIS_TP)]
+        specs += [PartitionSpec()] * (len(arrs) - 2)
+        placed = tuple(
+            jax.device_put(a, named_sharding(s, mesh=self._mesh))
+            for a, s in zip(arrs, specs))
+        self._slab_cache = (key, arrs, placed)
+        return placed
+
     # -- unified dispatch --------------------------------------------------
     def _unified_fn(self):
         """The unified program for this dispatch: greedy-only (no
@@ -1910,6 +2058,8 @@ class ServingEngine:
             name = (f"unified/W{self._width}/S{self.spec_tokens}"
                     f"/{variant}" if self.speculative
                     else f"unified/W{self._width}/{variant}")
+            if self._tp > 1:
+                name += f"/tp{self._tp}"
             fn = self._wrap_program(self._build_unified(greedy_only),
                                     name)
             self._programs[greedy_only] = fn
@@ -1928,6 +2078,7 @@ class ServingEngine:
         spec = self.speculative
         S = self.spec_tokens
         quant = self._quant
+        tp = self._tp
 
         def unified(param_arrays, kp, vp, table, lock, lengths, cur_tok,
                     done, remaining, counters, seeds, temp, top_k,
@@ -1945,6 +2096,10 @@ class ServingEngine:
                 aslot, a_A, a_B, a_scale, *a_qs = adapter
                 prev_ctx = _set_adapter_ctx(
                     (a_A, a_B, a_scale, aslot) + tuple(a_qs))
+            # tp > 1: this body traces INSIDE the shard_map, so the
+            # model sees per-shard weight slices; the tp context makes
+            # the attention head split and the proj/fc2 psum explicit
+            prev_tp = _set_tp_ctx((AXIS_TP, tp)) if tp > 1 else None
             try:
                 for p, d in zip(params, param_arrays):
                     arr = NDArray(d)
@@ -2048,6 +2203,8 @@ class ServingEngine:
             finally:
                 if adapter:
                     _set_adapter_ctx(prev_ctx)
+                if tp > 1:
+                    _set_tp_ctx(prev_tp)
                 _trace_channel.pop_frame()
                 for p, d in zip(params, saved):
                     p._data = d
@@ -2063,7 +2220,42 @@ class ServingEngine:
         donate = (1, 2)
         if quant:
             donate += (22, 23) if spec else (20, 21)
-        return jax.jit(unified, donate_argnums=donate)
+        if tp == 1:
+            return jax.jit(unified, donate_argnums=donate)
+        # tp > 1: the SAME body runs shard_map'ed over the {tp: N}
+        # mesh. KV pools and int8 scales enter/leave split on the head
+        # axis; weights enter per the serving tp rules; everything the
+        # host schedules with (tables, locks, slot scalars, token
+        # grids, drafts) is replicated, and every scalar OUTPUT is
+        # replicated too — each shard computes the identical
+        # post-psum sampler, so the result is well-defined without a
+        # replication check (check_rep off: psum breaks jax's
+        # conservative replication inference).
+        kv, rep = self._kv_pspec(), PartitionSpec()
+        # positions 3..19: table, lock, the 11 slot scalars, toks_in,
+        # chunk_len, is_final, decode_mask — all replicated
+        in_specs = [tuple(self._param_specs), kv, kv] + [rep] * 17
+        if spec:
+            in_specs += [rep, rep]            # drafts, n_draft
+        if quant:
+            in_specs += [self._scale_pspec()] * 2
+        if self.adapter_pool is not None:
+            in_specs += [rep,                  # aslot
+                         PartitionSpec(None, None, None, AXIS_TP,
+                                       None),  # A (input/U axis)
+                         PartitionSpec(None, None, None, None,
+                                       AXIS_TP),  # B (output axis)
+                         rep]                  # scale
+            if self.adapter_pool.quantized:
+                in_specs += [rep, rep]         # a_scale, b_scale
+        out_specs = [kv, kv] + [rep] * 9
+        if quant:
+            out_specs += [self._scale_pspec()] * 2
+        fn = shard_map_compat(unified, mesh=self._mesh,
+                              in_specs=tuple(in_specs),
+                              out_specs=tuple(out_specs),
+                              check_rep=False)
+        return jax.jit(fn, donate_argnums=donate)
 
     def _dispatch(self):
         """ONE unified dispatch: assemble the per-slot work rows
@@ -2131,7 +2323,7 @@ class ServingEngine:
                     toks_in[slot, 1:1 + d.size] = d
         self._chunk_rr = (self._chunk_rr + 1) % B
         fn = self._unified_fn()
-        param_datas = tuple(p.data()._data for p in self._params)
+        param_datas = self._placed_params()
         st = self._dstate
         (lengths, cur_tok, done, remaining, counters, seeds, temp,
          top_k, top_p, do_sample, eos) = st[:11]
@@ -2240,8 +2432,8 @@ class ServingEngine:
                             req.prompt,
                             [int(p)
                              for p in self._table_host[slot][:n_full]])
-                        self._d_lock = jnp.asarray(
-                            self._page_lock_host())
+                        self._d_lock = self._rep(jnp.asarray(
+                            self._page_lock_host()))
                     self._set_pool_gauges()
                 if spec:
                     self._hist[slot] = [int(t) for t in req.prompt] \

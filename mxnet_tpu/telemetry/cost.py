@@ -82,7 +82,8 @@ class _ProgramRecord:
 
     __slots__ = ("program", "flops", "bytes_accessed", "source",
                  "compiles", "compile_seconds", "dispatches",
-                 "dispatch_seconds", "last_seconds", "last_compile_ts")
+                 "dispatch_seconds", "last_seconds", "last_compile_ts",
+                 "shards")
 
     def __init__(self, program):
         self.program = program
@@ -95,6 +96,7 @@ class _ProgramRecord:
         self.dispatch_seconds = 0.0
         self.last_seconds = None
         self.last_compile_ts = None
+        self.shards = 1
 
 
 _P = ("program",)
@@ -222,11 +224,17 @@ def _record(program):
 
 
 def register_program(program, flops=None, bytes_accessed=None,
-                     source="xla"):
+                     source="xla", shards=1):
     """Register (or refresh) a program's static cost. `flops`/`bytes_
-    accessed` of ONE dispatch; non-finite / non-positive values are
-    treated as unknown (backends that don't report costs). Returns the
-    record."""
+    accessed` of ONE dispatch — the WHOLE-MODEL figures, summed over
+    partitions for an SPMD program (callers extracting from a sharded
+    executable multiply the per-partition cost_analysis() up before
+    registering; CostedFunction(shards=N) does this). `shards` is the
+    partition count: note_dispatch divides by it so the per-chip MFU /
+    bandwidth gauges stay honest under tp>1 while `.flops` keeps
+    feeding whole-model goodput counters. Non-finite / non-positive
+    values are treated as unknown (backends that don't report costs).
+    Returns the record."""
     def _clean(v):
         if v is None:
             return None
@@ -241,6 +249,7 @@ def register_program(program, flops=None, bytes_accessed=None,
         if bytes_accessed is not None:
             rec.bytes_accessed = bytes_accessed
         rec.source = source
+        rec.shards = max(int(shards), 1)
         flops, bytes_accessed = rec.flops, rec.bytes_accessed
     m = _metrics()
     if flops is not None:
@@ -295,19 +304,23 @@ def note_dispatch(program, seconds):
         rec.dispatch_seconds += seconds
         rec.last_seconds = seconds
         flops, nbytes = rec.flops, rec.bytes_accessed
+        sh = rec.shards or 1
     m = _metrics()
     m["dispatches"].labels(program).inc()
     m["dispatch_seconds"].labels(program).inc(seconds)
+    # registered cost is whole-model; the gauges compare against ONE
+    # chip's peak, so a tp=N program's achieved figures divide by the
+    # shard count (each chip only did 1/N of the FLOPs in that wall)
     if flops is not None:
         pf, _, _ = peaks()
-        m["mfu"].labels(program).set(flops / seconds / pf)
-        m["achieved_flops"].labels(program).set(flops / seconds)
+        m["mfu"].labels(program).set(flops / seconds / pf / sh)
+        m["achieved_flops"].labels(program).set(flops / seconds / sh)
         # re-assert the static gauge so a telemetry.reset() between
         # bench rounds heals on the next dispatch (set only on change
         # would read a lock anyway; one blind set is the same cost)
         m["program_flops"].labels(program).set(flops)
     if nbytes is not None:
-        m["achieved_bw"].labels(program).set(nbytes / seconds)
+        m["achieved_bw"].labels(program).set(nbytes / seconds / sh)
         m["program_bytes"].labels(program).set(nbytes)
     return rec
 
@@ -323,14 +336,15 @@ def get(program):
 
 def _snap(rec):
     out = {k: getattr(rec, k) for k in _ProgramRecord.__slots__}
+    sh = rec.shards or 1
     if rec.flops and rec.bytes_accessed:
         out["arithmetic_intensity"] = rec.flops / rec.bytes_accessed
     if rec.flops and rec.last_seconds:
         pf, pb, _ = peaks()
-        out["mfu"] = rec.flops / rec.last_seconds / pf
+        out["mfu"] = rec.flops / rec.last_seconds / pf / sh
         if rec.bytes_accessed:
             out["bandwidth_util"] = (rec.bytes_accessed
-                                     / rec.last_seconds / pb)
+                                     / rec.last_seconds / pb / sh)
     return out
 
 
@@ -413,19 +427,29 @@ class CostedFunction:
     steps per dispatch (the serving engine's K-step decode scan) must
     pass its trip count here for the per-dispatch cost to be honest.
 
+    ``shards``: SPMD partition count of the program. ``cost_analysis()``
+    on a sharded executable reports PER-PARTITION figures, so they are
+    multiplied by `shards` before registration (the registry holds
+    whole-model cost) and `note_dispatch` divides its per-chip gauges
+    back down — `cost_mfu{program}` stays an honest fraction of ONE
+    chip's peak at any tp.
+
     If AOT lowering fails (exotic backend), the wrapper falls back to
     calling the jitted function directly — the compile is then timed
     inside the first dispatch, and the program registers without cost
     figures (MFU gauges simply stay absent)."""
 
-    __slots__ = ("_fn", "program", "_steady_fn", "_call", "_cost_scale")
+    __slots__ = ("_fn", "program", "_steady_fn", "_call", "_cost_scale",
+                 "_shards")
 
-    def __init__(self, fn, program, steady_fn=None, cost_scale=1.0):
+    def __init__(self, fn, program, steady_fn=None, cost_scale=1.0,
+                 shards=1):
         self._fn = fn
         self.program = str(program)
         self._steady_fn = steady_fn
         self._call = None
         self._cost_scale = float(cost_scale)
+        self._shards = max(int(shards), 1)
 
     def __call__(self, *args):
         call = self._call
@@ -440,10 +464,11 @@ class CostedFunction:
                 call = self._fn        # jit compiles inside call #1
             dt = time.perf_counter() - t0
             self._call = call
-            s = self._cost_scale
+            s = self._cost_scale * self._shards
             register_program(self.program,
                              flops * s if flops else flops,
-                             nbytes * s if nbytes else nbytes)
+                             nbytes * s if nbytes else nbytes,
+                             shards=self._shards)
             steady = False
             if self._steady_fn is not None:
                 try:

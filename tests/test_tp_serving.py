@@ -1,0 +1,354 @@
+"""ISSUE 15: tensor-parallel serving — the unified ragged dispatch
+shard_map'ed head-wise across the serving tp mesh.
+
+The committed contract (docs/SERVING.md "Tensor-parallel serving"):
+shard count is a construction-time MODE, never a shape axis — a tp=N
+engine owns the same two compiled programs a tp=1 engine does and
+holds steady_state_compiles == 0 — and greedy token streams are
+bit-identical tp=1 vs tp=N across every serving mode (plain, prefix
+CoW, speculative verify, int8 KV pages, float and int8 adapter
+slabs). The quantizer is head-local, so sharding adds no quantization
+error of its own: layer-0 int8 codes and scales roundtrip exactly
+between shard counts, and deeper layers — whose inputs carry the
+per-layer psum's reassociation noise — match to fp tolerance, as do
+logits (~1e-6), which greedy argmax must not see.
+Migration composes for free — export/adopt moves host tokens, never
+pages, so a kill-mid-decode request re-prefills under the adoptee's
+OWN mesh (tp=2 dies, tp=4 adopts) and stays bit-identical to a
+fault-free tp=1 run.
+
+tests/conftest.py forces 8 virtual CPU devices, so the tp=2/4 meshes
+exist here; every test still guards on jax.device_count() for
+stand-alone invocation.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.models import GPT2Config, GPT2ForCausalLM
+from mxnet_tpu.parallel.mesh import AXIS_TP, PartitionSpec
+from mxnet_tpu.serving import (ReplicaFaultPlan, Request, ServingEngine,
+                               ServingRouter)
+from mxnet_tpu.serving.adapters import AdapterPool, random_lora
+from mxnet_tpu.telemetry import cost as _cost
+
+_need4 = pytest.mark.skipif(
+    jax.device_count() < 4,
+    reason="needs >= 4 devices (conftest forces 8 on CPU; standalone "
+           "runs need XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+
+_NET = {}
+
+
+def _tiny(vocab=97, layers=2, units=32, heads=4, max_len=64, seed=3):
+    # heads=4 (not test_quant_kv's 2) so the tp=4 mesh divides the
+    # head axis; hidden stays 4*units = 128, divisible by 4 too
+    key = (vocab, layers, units, heads, max_len, seed)
+    if key not in _NET:
+        cfg = GPT2Config(vocab_size=vocab, units=units, num_layers=layers,
+                         num_heads=heads, max_length=max_len, dropout=0.0,
+                         attention_dropout=0.0)
+        net = GPT2ForCausalLM(cfg)
+        mx.rng.seed(seed)
+        net.initialize(mx.init.Normal(0.05))
+        _NET[key] = (net, cfg)
+    return _NET[key]
+
+
+def _prompts(n=4, seed=7, lo=3, hi=18):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 97, size=int(rng.integers(lo, hi))).tolist()
+            for _ in range(n)]
+
+
+def _serve(net, prompts, tp, max_new=8, adapter_ids=None, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_length", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("attn_impl", "xla")
+    eng = ServingEngine(net, tp=tp, **kw)
+    aid = adapter_ids or [None] * len(prompts)
+    reqs = [Request(p, max_new, request_id=i, seed=100 + i,
+                    adapter_id=aid[i])
+            for i, p in enumerate(prompts)]
+    eng.serve(reqs)
+    return {r.id: list(r.output_tokens) for r in reqs}, eng
+
+
+# ---------------------------------------------------------------------------
+# constructor contract
+# ---------------------------------------------------------------------------
+
+def test_tp_constructor_validation():
+    net, _ = _tiny()
+    with pytest.raises(MXNetError, match="tp must be >= 1"):
+        ServingEngine(net, num_slots=2, max_length=64, page_size=8,
+                      attn_impl="xla", tp=-1)
+    with pytest.raises(MXNetError, match="divide num_heads"):
+        ServingEngine(net, num_slots=2, max_length=64, page_size=8,
+                      attn_impl="xla", tp=3)
+
+
+@_need4
+def test_tp_mesh_needs_devices():
+    net, _ = _tiny()
+    with pytest.raises(MXNetError, match="device"):
+        ServingEngine(net, num_slots=2, max_length=64, page_size=8,
+                      attn_impl="xla", tp=4,
+                      tp_devices=jax.devices()[:2])
+
+
+# ---------------------------------------------------------------------------
+# engine golden bit-identity: greedy streams equal tp=1 across modes
+# ---------------------------------------------------------------------------
+
+@_need4
+@pytest.mark.parametrize("mode,kw", [
+    ("plain", {}),
+    ("int8", dict(kv_dtype="int8")),
+    ("speculative", dict(speculative=True, spec_tokens=4)),
+])
+def test_tp_greedy_bit_identical(mode, kw):
+    net, _ = _tiny()
+    ps = _prompts()
+    want, _ = _serve(net, ps, tp=1, **kw)
+    for tp in (2, 4):
+        got, eng = _serve(net, ps, tp=tp, **kw)
+        assert got == want, (mode, tp)
+        assert eng.audit_pages() == []
+        assert eng.stats["tp_shards"] == tp
+
+
+@_need4
+def test_tp_prefix_cache_bit_identical():
+    """Prefix attach + CoW divergence under sharding: the page table
+    and refcounts are replicated host state, the CoW page copy is an
+    eager op on the head-sharded pool — both shard counts must take
+    the same hits and emit the same tokens. Served sequentially so
+    each prompt's pages are published before the next can attach."""
+    net, _ = _tiny()
+    shared = np.random.default_rng(11).integers(
+        1, 97, size=16).tolist()
+    ps = [shared + [5], shared + [9], shared]
+
+    def run(tp):
+        eng = ServingEngine(net, num_slots=4, max_length=64,
+                            page_size=8, attn_impl="xla", tp=tp,
+                            prefix_cache=True)
+        out = []
+        for i, p in enumerate(ps):
+            r = Request(p, 8, request_id=i, seed=100 + i)
+            eng.serve([r])
+            out.append(list(r.output_tokens))
+        return out, eng
+
+    want, e1 = run(1)
+    h1 = e1.stats["prefix_tokens_saved"]
+    assert h1 > 0
+    for tp in (2, 4):
+        got, eng = run(tp)
+        assert got == want, tp
+        assert eng.stats["prefix_tokens_saved"] == h1
+        assert eng.audit_pages() == []
+
+
+@_need4
+@pytest.mark.parametrize("slab_dtype", [None, "int8"])
+def test_tp_adapters_bit_identical(slab_dtype):
+    """LoRA under tp: the A slab shards on its U axis, B on its output
+    axis (the same head-aligned split as the base weights), and the
+    per-shard delta lands inside the projection's single psum —
+    adapter and base requests interleaved must both match tp=1."""
+    net, cfg = _tiny()
+    ps = _prompts()
+    aid = ["a" if i % 2 else None for i in range(len(ps))]
+
+    def pool():
+        p = AdapterPool(cfg, slots=3, max_rank=2, dtype=slab_dtype)
+        p.register("a", random_lora(cfg, rank=2, seed=41))
+        return p
+
+    want, _ = _serve(net, ps, tp=1, adapter_pool=pool(),
+                     adapter_ids=aid)
+    for tp in (2, 4):
+        got, eng = _serve(net, ps, tp=tp, adapter_pool=pool(),
+                          adapter_ids=aid)
+        assert got == want, (slab_dtype, tp)
+        assert eng.audit_pages() == []
+
+
+# ---------------------------------------------------------------------------
+# int8 scale leaves: sharded layout, exact roundtrip vs tp=1
+# ---------------------------------------------------------------------------
+
+@_need4
+def test_tp_int8_scale_leaves_roundtrip():
+    """Quantization is per-(layer, page, head) and head-LOCAL, so
+    sharding adds no quantization error of its own: layer 0 sees the
+    replicated embeddings and its codes and scales roundtrip
+    bit-for-bit between shard counts. Deeper layers read activations
+    reassembled by the per-layer psum, whose fixed reduction order
+    carries ~1e-9 reassociation noise into the quantizer inputs —
+    those leaves match to fp tolerance (codes within one step), which
+    is exactly the contract's shape: state is fp-close, token streams
+    are exact. The leaves must also LIVE head-sharded next to their
+    codes."""
+    net, _ = _tiny()
+    ps = _prompts(n=2)
+    _, e1 = _serve(net, ps, tp=1, kv_dtype="int8")
+    _, e2 = _serve(net, ps, tp=2, kv_dtype="int8")
+    assert e2._ks.sharding.spec == PartitionSpec(None, None, AXIS_TP)
+    # jax trims the trailing None off the stored pool spec
+    assert e2._kp.sharding.spec == PartitionSpec(
+        None, None, None, AXIS_TP)
+    for a, b in ((e1._ks, e2._ks), (e1._vs, e2._vs),
+                 (e1._kp, e2._kp), (e1._vp, e2._vp)):
+        a, b = np.asarray(a), np.asarray(b)
+        np.testing.assert_array_equal(a[0], b[0])     # layer 0 exact
+        if a.dtype == np.int8:
+            assert np.abs(a.astype(np.int16)
+                          - b.astype(np.int16)).max() <= 1
+        else:
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-8)
+
+
+# ---------------------------------------------------------------------------
+# per-chip HBM budget
+# ---------------------------------------------------------------------------
+
+@_need4
+def test_tp_hbm_budget_is_per_chip():
+    """hbm_budget_bytes is PER CHIP: each page costs page_bytes/tp on
+    a chip, so the same budget admits tp x the pages."""
+    net, _ = _tiny()
+    # 4096 B/page fp32 here: 32 KiB affords 8 pages at tp=1 (binding —
+    # below the 16-page natural pool) and 16 at tp=2
+    budget = 32 * 1024
+
+    def pages(tp):
+        eng = ServingEngine(net, num_slots=2, max_length=64, page_size=8,
+                            attn_impl="xla", tp=tp,
+                            hbm_budget_bytes=budget)
+        return eng.page_pool.num_pages, eng
+
+    p1, _ = pages(1)
+    p2, e2 = pages(2)
+    assert p2 == 2 * p1
+    blk = e2._statusz()["sharding"]
+    assert blk["kv_page_bytes_per_chip"] * 2 == e2.stats["kv_page_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# statusz / gauges
+# ---------------------------------------------------------------------------
+
+@_need4
+def test_tp_statusz_sharding_block():
+    net, cfg = _tiny()
+    _, eng = _serve(net, _prompts(n=1), tp=2)
+    z = eng._statusz()
+    assert z["config"]["tp_shards"] == 2
+    blk = z["sharding"]
+    assert blk["tp_shards"] == 2
+    assert len(blk["mesh_devices"]) == 2
+    assert blk["heads_per_shard"] == cfg.num_heads // 2
+    assert "page_table" in blk["replicated"]
+    # unsharded engines report no sharding block at all
+    _, e1 = _serve(net, _prompts(n=1), tp=1)
+    assert e1._statusz()["sharding"] is None
+    assert e1.stats["tp_shards"] == 1
+
+
+# ---------------------------------------------------------------------------
+# compile discipline: tp is a mode, not a shape axis
+# ---------------------------------------------------------------------------
+
+@_need4
+def test_tp_engine_compile_flat_steady_state():
+    """The whole stack on at once — tp=2 + int8 pages + prefix cache +
+    int8 adapter slab — and after warmup (one greedy, one adapter'd,
+    one sampled) NO serve may compile again: arbitrary lengths, prefix
+    attach, a fully-cached prompt, and an adapter'd sampled request
+    all ride the two warm programs."""
+    net, cfg = _tiny()
+    pool = AdapterPool(cfg, slots=3, max_rank=2, dtype="int8")
+    pool.register("a", random_lora(cfg, rank=2, seed=41))
+    rng = np.random.default_rng(5)
+    shared = rng.integers(1, 97, size=16).tolist()
+    eng = ServingEngine(net, num_slots=2, max_length=64, page_size=8,
+                        attn_impl="xla", kv_dtype="int8",
+                        prefix_cache=True, adapter_pool=pool, tp=2)
+    eng.serve([Request(shared + [5], 3, request_id="warm"),
+               Request([1, 2, 3], 3, request_id="warm2",
+                       adapter_id="a"),
+               Request([4, 4], 3, request_id="warm3", do_sample=True,
+                       seed=0)])
+    eng.mark_warm()
+    before = {fn.program: _cost.get(fn.program)["compiles"]
+              for fn in eng._programs.values()}
+    assert all(p.endswith("/tp2") for p in before)
+    for n in (5, 23, 31):
+        eng.serve([Request(rng.integers(1, 97, size=n).tolist(), 3)])
+    eng.serve([Request(shared + [9], 3)])      # prefix attach
+    eng.serve([Request(shared, 2)])            # fully cached prompt
+    eng.serve([Request([8, 9, 10], 3, adapter_id="a", do_sample=True,
+                       seed=1)])
+    after = {fn.program: _cost.get(fn.program)["compiles"]
+             for fn in eng._programs.values()}
+    assert after == before
+    assert len(eng._programs) == 2
+    assert eng.audit_pages() == []
+
+
+# ---------------------------------------------------------------------------
+# router migration across shard counts
+# ---------------------------------------------------------------------------
+
+@_need4
+def test_tp_router_kill_mid_decode_migrates_across_shard_counts():
+    """A tp=2 replica killed mid-decode hands its in-flight requests
+    to a tp=4 survivor, which re-prefills them under its OWN mesh —
+    export/adopt moves host tokens, never device pages, so no
+    re-sharding code exists to get wrong — and every greedy output
+    equals the fault-free tp=1 run."""
+    net, _ = _tiny()
+
+    def _engine(tp):
+        return ServingEngine(net, num_slots=2, max_length=32,
+                             page_size=8, attn_impl="xla", tp=tp,
+                             chunk_tokens=8, prefill_chunk_budget=64)
+
+    def _reqs():
+        rng = np.random.default_rng(9)
+        return [Request(rng.integers(
+                    1, 97, size=int(rng.integers(3, 9))).tolist(),
+                    6, request_id=i, seed=100 + i)
+                for i in range(8)]
+
+    base = _engine(1)
+    want_reqs = _reqs()
+    base.serve(want_reqs)
+    want = {r.id: list(r.output_tokens) for r in want_reqs}
+
+    engines = [_engine(2), _engine(4)]
+    router = ServingRouter(engines)
+    plan = ReplicaFaultPlan(kill={4: 0}).install(router)
+    try:
+        reqs = _reqs()
+        for r in reqs:
+            router.submit(r)
+        n = 0
+        while router.has_work and n < 5000:
+            router.step()
+            n += 1
+    finally:
+        plan.uninstall()
+    assert plan.counts["kill"] == 1
+    assert {r.status for r in reqs} == {"finished"}
+    assert {r.id: list(r.output_tokens) for r in reqs} == want
+    assert router.stats["migrated"] >= 1
+    assert engines[1].audit_pages() == []

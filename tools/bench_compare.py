@@ -54,7 +54,7 @@ _LOWER_BETTER = ("latency", "_ms", "seconds", "bytes", "loss",
 # lower-is-better token sharing the name (e.g. `bytes` inside
 # `capacity_at_bytes.admitted_pages`) can't flip the direction
 _HIGHER_BETTER = ("goodput", "admitted_slots", "admitted_pages",
-                  "tokens_per_s", "throughput", "capacity")
+                  "tokens_per_s", "throughput", "capacity", "per_chip")
 
 
 def lower_is_better(name):

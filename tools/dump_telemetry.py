@@ -443,8 +443,15 @@ def main():
             mfu = s.get("mfu")
             avg = (s["dispatch_seconds"] / s["dispatches"] * 1e3
                    if s["dispatches"] else 0.0)
+            # registered flops are whole-model; a tp=N program's
+            # per-chip share is the number that sits on one chip's
+            # roofline (the MFU figure already divides by shards)
+            sh = s.get("shards") or 1
             print(f"#   {prog}: "
-                  + (f"{s['flops'] / 1e6:.2f} MFLOP, " if s["flops"]
+                  + (f"{s['flops'] / 1e6:.2f} MFLOP"
+                     + (f" ({s['flops'] / sh / 1e6:.2f}/chip × {sh})"
+                        if sh > 1 else "")
+                     + ", " if s["flops"]
                      else "flops n/a, ")
                   + (f"AI {ai:.1f} ({s.get('bound', '?')}-bound), "
                      if ai else "")
@@ -461,6 +468,13 @@ def main():
                   f"({s['kv_page_bytes']} B/page, "
                   f"kv_dtype {'int8' if s['kv_quant_enabled'] else 'fp'}"
                   f", quant {'on' if s['kv_quant_enabled'] else 'off'})")
+            if s.get("tp_shards", 1) > 1:
+                tp = s["tp_shards"]
+                print(f"# per-chip: {tp} tp shards — each chip holds "
+                      f"{s['kv_page_bytes'] // tp} B/page and did 1/{tp} "
+                      "of the FLOPs above; tokens/sec/chip divides "
+                      "goodput by the shard count (docs/SERVING.md "
+                      '"Tensor-parallel serving")')
         led = telemetry.ledger.snapshot()
         live = led.get("live_array_bytes")
         unattr = led.get("unattributed_bytes")

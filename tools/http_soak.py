@@ -167,9 +167,22 @@ def main(argv=None):
                     help="KV page storage: int8 runs the whole soak — "
                          "chaos, kill-migration, bit-identity bar — "
                          "through quantized pages with fused dequant")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel shards per replica engine — "
+                         "the offline reference stays tp=1, so the "
+                         "bit-identity bar also proves the sharded "
+                         "fleet matches an unsharded engine (on a CPU "
+                         "host the virtual device count is forced "
+                         "automatically)")
     ap.add_argument("--json", default=None,
                     help="also write the summary JSON to this path")
     args = ap.parse_args(argv)
+    if (args.tp > 1 and "jax" not in sys.modules
+            and "host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.tp}")
 
     # the soak is exactly the workload the ownership assertions exist
     # for: HTTP handler threads racing a serving loop under chaos.
@@ -187,7 +200,9 @@ def main(argv=None):
                                    ServingEngine, ServingFrontend,
                                    ServingRouter)
 
-    cfg = GPT2Config(vocab_size=97, units=32, num_layers=2, num_heads=2,
+    # tp shards head-wise, so the toy model grows heads to match
+    cfg = GPT2Config(vocab_size=97, units=32, num_layers=2,
+                     num_heads=max(2, args.tp),
                      max_length=64, dropout=0.0, attention_dropout=0.0)
     mx.rng.seed(3)
     net = GPT2ForCausalLM(cfg)
@@ -221,7 +236,7 @@ def main(argv=None):
             body["stream_buffer"] = 2       # < decode_block
         bodies.append(body)
 
-    def new_engine(max_queue=None):
+    def new_engine(max_queue=None, tp=1):
         kv = None if args.kv_dtype == "float32" else args.kv_dtype
         # int8 pages: the chunk grid is part of the numerics, so the
         # bit-identity bar needs a non-binding prefill budget — every
@@ -232,7 +247,8 @@ def main(argv=None):
         eng = ServingEngine(net, num_slots=slots, max_length=max_len,
                             page_size=page, decode_block=block,
                             attn_impl="xla", max_queue=max_queue,
-                            kv_dtype=kv, prefill_chunk_budget=budget)
+                            kv_dtype=kv, prefill_chunk_budget=budget,
+                            tp=tp)
         # warm every prefill bucket a migrated request can land in
         # (re-prefill covers prompt + already-emitted tokens)
         eng.serve([Request(list(range(1, b + 1)), 2,
@@ -253,7 +269,8 @@ def main(argv=None):
                  for r in ref_reqs}
     assert all(r.status == "finished" for r in ref_reqs)
 
-    engines = [new_engine(max_queue=4) for _ in range(args.replicas)]
+    engines = [new_engine(max_queue=4, tp=args.tp)
+               for _ in range(args.replicas)]
     compiles_at_warm = {e._eid: _compiles(e._eid) for e in engines}
     router = ServingRouter(engines, hedge_after_s=1e9)
     plan = None
@@ -431,6 +448,7 @@ def main(argv=None):
 
     summary = {
         "requests": args.requests,
+        "tp": args.tp,
         "soak_seconds": round(soak_s, 3),
         "requests_by_code": by_code,
         "admitted": admitted,
