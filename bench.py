@@ -1945,6 +1945,198 @@ def bench_gpt2_serving_quantkv():
     return 0 if ok else 1
 
 
+def bench_gpt2_serving_kvspill():
+    """Tiered KV cache A/B at ONE fixed HBM page budget (docs/
+    SERVING.md "Tiered KV cache"): a Poisson shared-prefix stream
+    whose distinct prefix working set is >= 3x the HBM page budget, so
+    the prefix cache MUST evict between revisits. Spill OFF discards
+    the evicted pages and re-prefills every revisit from scratch;
+    spill ON moves them to a host-RAM tier and pages them back in on
+    the radix hit — same fixed-shape dispatch, tier traffic outside
+    the traced graph. Pass criteria: spill-on goodput >= 1.3x
+    spill-off, STRICTLY fewer prefilled tokens, 0 greedy output
+    mismatches vs the spill-off engine (the tier's exactness
+    contract), zero steady-state compiles on BOTH engines, clean page
+    + host-tier audits, everything finished. vs_baseline is the
+    on/off goodput ratio (>1 = page-in beat re-prefill)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import GPT2ForCausalLM, gpt2_774m_config
+    from mxnet_tpu.serving import Request, ServingEngine
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8 if on_tpu else 2))
+    visits = int(os.environ.get("BENCH_KVSPILL_VISITS", 3))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", 0))  # req/s; 0=open
+    cfg = gpt2_774m_config(dtype="bfloat16" if on_tpu else "float32",
+                           dropout=0.0, attention_dropout=0.0)
+    max_len, page = 1024, 64
+    if not on_tpu:  # CPU smoke config
+        cfg.vocab_size, cfg.units, cfg.hidden_size = 512, 256, 1024
+        cfg.num_layers, cfg.num_heads, cfg.max_length = 2, 4, 128
+        max_len, page = 128, 8
+
+    net = GPT2ForCausalLM(cfg)
+    net.initialize(mx.init.Normal(0.02))
+    if on_tpu:
+        net.cast("bfloat16")
+
+    P = max_len // page
+    # each family's shared prefix fills 3/4 of a slot's pages; the
+    # rest is the unique tail + decode room
+    prefix_pages = (3 * P) // 4
+    prefix_len = prefix_pages * page
+    L, H = cfg.num_layers, cfg.num_heads
+    Dh = cfg.units // cfg.num_heads
+    page_bytes = 2 * L * page * H * Dh * \
+        (2 if cfg.dtype == "bfloat16" else 4)
+    # HBM budget: the natural dispatch pool + only 4 retention pages —
+    # far too small to keep any family's prefix resident between
+    # revisits. The host tier gets room for the whole working set.
+    budget_pages = slots * P + 4
+    hbm_budget = page_bytes * budget_pages
+    families = max(4, -(-3 * budget_pages // prefix_pages))
+    working_set_pages = families * prefix_pages
+    host_budget = page_bytes * (working_set_pages + 8 * P)
+
+    rng = np.random.default_rng(17)
+    prefixes = [rng.integers(0, cfg.vocab_size, prefix_len).tolist()
+                for _ in range(families)]
+
+    def mk_requests(id0):
+        # round-robin over families so every revisit arrives AFTER the
+        # budget forced its prefix out of HBM
+        out = []
+        for v in range(visits):
+            for f in range(families):
+                out.append(Request(
+                    prefixes[f] + [1 + v, 2 + f],  # unique tail
+                    3, request_id=f"{id0}-v{v}f{f}"))
+        return out
+
+    def run_config(tag, host_bytes):
+        eng = ServingEngine(net, num_slots=slots, max_length=max_len,
+                            page_size=page, prefix_cache=True,
+                            hbm_budget_bytes=hbm_budget,
+                            host_kv_bytes=host_bytes,
+                            chunk_tokens=page,
+                            prefill_chunk_budget=slots * page)
+        # warm the dispatch on full-length prefills (the budget fixes
+        # the chunk grid) and the tail/decode shapes. Three distinct
+        # long prefixes overflow the tiny retention budget, so the
+        # spill engine ALSO compiles its tier gather here, and the
+        # revisit of the first (now spilled) prefix compiles the
+        # page-in scatter — tier jits never land inside measurement.
+        warm = [[(w * 37 + t) % cfg.vocab_size
+                 for t in range(1, prefix_len + 2)] for w in range(3)]
+        for w, p in enumerate(warm):
+            eng.serve([Request(p, 3, request_id=f"{tag}-warm-long{w}")])
+        eng.serve([Request(warm[0], 3, request_id=f"{tag}-warm-again")])
+        eng.serve([Request([7, 8, 9], 3, request_id=f"{tag}-warm-short")])
+        eng.mark_warm()
+        c0 = _engine_compiles(eng._eid)
+        eng.reset_stats()
+
+        reqs = mk_requests(id0=tag)
+        rng = np.random.default_rng(13)
+        gaps = rng.exponential(1.0 / rate, len(reqs)) if rate > 0 \
+            else np.zeros(len(reqs))
+        arrivals = np.cumsum(gaps)
+        t0 = time.perf_counter()
+        pending = list(zip(arrivals, reqs))
+        while pending or eng.has_work:
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                eng.submit(pending.pop(0)[1])
+            if eng.has_work:
+                eng.step()
+            elif pending:
+                time.sleep(min(pending[0][0] - now, 0.01))
+        dt = time.perf_counter() - t0
+
+        fin = [r for r in reqs if r.status == "finished"]
+        tokens = sum(len(r.output_tokens) for r in fin)
+        s = eng.stats
+        hits, misses = s["prefix_hits"], s["prefix_misses"]
+        host_audit = [] if eng.host_pool is None else eng.host_pool.audit()
+        return {
+            "spill": host_bytes is not None,
+            "goodput_tokens_per_sec": round(tokens / dt, 2),
+            "makespan_s": round(dt, 3),
+            "prefill_tokens": s["prefill_tokens"],
+            "prefix_hits": hits, "prefix_misses": misses,
+            "hit_rate": round(hits / max(hits + misses, 1), 4),
+            "prefix_tokens_saved": s["prefix_tokens_saved"],
+            "kv_spill_pages": s["kv_spill_pages"],
+            "kv_pagein_pages": s["kv_pagein_pages"],
+            "kv_host_evictions": s["kv_host_evictions"],
+            "finished": len(fin), "requests": len(reqs),
+            "steady_state_compiles": _engine_compiles(eng._eid) - c0,
+            "audit_leaks": len(eng.audit_pages()) + len(host_audit),
+            "outputs": {r.id.split("-", 1)[1]: list(r.output_tokens)
+                        for r in reqs},
+            "device_cost": _device_cost_extras(eng._eid),
+        }
+
+    off = run_config("off", None)
+    on = run_config("on", host_budget)
+
+    # the tier's exactness contract: greedy outputs bit-identical to
+    # the spill-off engine — page-in must never change a token
+    out_off, out_on = off.pop("outputs"), on.pop("outputs")
+    mismatches = sum(int(out_off[k] != out_on[k]) for k in out_off)
+
+    goodput_ratio = round(on["goodput_tokens_per_sec"]
+                          / max(off["goodput_tokens_per_sec"], 1e-9), 3)
+    prefill_ratio = round(off["prefill_tokens"]
+                          / max(on["prefill_tokens"], 1), 3)
+    extras = {
+        "hbm_budget_bytes": hbm_budget,
+        "hbm_budget_pages": budget_pages,
+        "host_budget_bytes": host_budget,
+        "working_set_pages": working_set_pages,
+        "working_set_over_budget": round(
+            working_set_pages / budget_pages, 2),
+        "prefix_families": families, "visits": visits,
+        "prefix_len": prefix_len,
+        "greedy_mismatches": mismatches,
+        "on": on, "off": off,
+        "slots": slots,
+        "arrivals": "open-loop" if rate == 0 else f"poisson({rate}/s)",
+        "params": cfg.num_params(),
+        "device": str(dev.device_kind),
+        "baseline": "spill-off prefix cache at the SAME "
+                    "hbm_budget_bytes on the same stream (evictions "
+                    "discard; revisits re-prefill)",
+    }
+    _emit("gpt2_serving_kvspill_goodput_tokens_per_sec",
+          on["goodput_tokens_per_sec"], "tokens/sec", goodput_ratio,
+          extras=extras)
+    # gate lanes: hit_rate (higher-better by name) and re-prefilled
+    # tokens (lower-better by name) — both tracked by bench_compare
+    # additive vs_baseline (1 + delta): the spill-off engine's hit
+    # rate is typically 0.0 here, so a ratio would be unbounded
+    _emit("gpt2_serving_kvspill_hit_rate", on["hit_rate"], "fraction",
+          round(1.0 + on["hit_rate"] - off["hit_rate"], 3),
+          extras={"off_hit_rate": off["hit_rate"]})
+    _emit("gpt2_serving_kvspill_reprefill_tokens", on["prefill_tokens"],
+          "tokens", prefill_ratio,
+          extras={"off_prefill_tokens": off["prefill_tokens"]})
+    ok = (working_set_pages >= 3 * budget_pages
+          and goodput_ratio >= 1.3
+          and on["prefill_tokens"] < off["prefill_tokens"]
+          and mismatches == 0
+          and on["kv_spill_pages"] >= 1
+          and on["kv_pagein_pages"] >= 1
+          and on["steady_state_compiles"] == 0
+          and off["steady_state_compiles"] == 0
+          and not on["audit_leaks"] and not off["audit_leaks"]
+          and on["finished"] == on["requests"]
+          and off["finished"] == off["requests"])
+    return 0 if ok else 1
+
+
 def bench_gpt2_serving_tp():
     """Tensor-parallel serving A/B: the SAME Poisson stream served by
     a tp=1 engine and a tp=N engine (head-wise shard_map over the
@@ -2550,6 +2742,9 @@ def main():
     if workload in ("serving_quantkv", "quantkv", "int8_kv",
                     "gpt2_serving_quantkv"):
         return bench_gpt2_serving_quantkv()
+    if workload in ("serving_kvspill", "kvspill", "kv_spill",
+                    "gpt2_serving_kvspill"):
+        return bench_gpt2_serving_kvspill()
     if workload in ("serving_tp", "tp", "tensor_parallel",
                     "gpt2_serving_tp"):
         return bench_gpt2_serving_tp()

@@ -1,11 +1,12 @@
 """Resource-discipline pass: every lease is released on exception
 edges.
 
-The page pool, prefix cache and adapter pool are refcounted
-(serving/page_pool.py, adapters.py): `alloc`/`incref`/`acquire` take a
-lease that MUST be returned by `decref`/`free`/`release`/`evict` on
-every exit path, or pages leak until an audit() catches the drift —
-the class of lease-leak bug PR 7 fixed by hand. This pass checks the
+The page pool, prefix cache, adapter pool and host KV tier are
+refcounted (serving/page_pool.py, adapters.py, host_tier.py):
+`alloc`/`incref`/`acquire`/`checkout` take a lease that MUST be
+returned by `decref`/`free`/`release`/`evict`/`discard` on every exit
+path, or pages leak until an audit() catches the drift — the class of
+lease-leak bug PR 7 fixed by hand. This pass checks the
 post-dominance property statically at every acquire-vocabulary call
 site: the call must be covered by
 
@@ -36,8 +37,8 @@ __all__ = ["run"]
 
 RULE = "resource-release-on-error"
 
-ACQUIRE_OPS = {"alloc", "incref", "acquire"}
-RELEASE_OPS = {"decref", "free", "release", "evict"}
+ACQUIRE_OPS = {"alloc", "incref", "acquire", "checkout"}
+RELEASE_OPS = {"decref", "free", "release", "evict", "discard"}
 
 
 def _is_lockish(name):

@@ -41,7 +41,51 @@ from jax import lax
 
 from ..base import MXNetError
 
-__all__ = ["KVCache", "PagedKVCache"]
+__all__ = ["KVCache", "PagedKVCache", "gather_kv_pages",
+           "scatter_kv_pages"]
+
+
+def gather_kv_pages(k_pages, v_pages, idx, k_scale=None, v_scale=None):
+    """Gather whole pages (rows of the page axis) out of paged pools —
+    the KV-spill tier's device→host read (serving/host_tier.py).
+
+    ``idx`` is a FIXED-width (P,) int32 vector so the jitted gather is
+    one program regardless of how many pages spill this step: the host
+    pads short batches with page 0 and slices the valid prefix off the
+    ``jax.device_get`` result. Returns (k, v, ks, vs) with k/v of shape
+    (L, P, page_size, H, D) and ks/vs (L, P, H) f32 (None on float
+    pools). Under tp=N sharded pools the take propagates the pools'
+    head-axis sharding into the slices; ``device_get`` then assembles
+    the global array — no reshard, no explicit sharding annotations
+    (same contract as the engine's _copy_page_fn)."""
+    k = jnp.take(k_pages, idx, axis=1)
+    v = jnp.take(v_pages, idx, axis=1)
+    ks = None if k_scale is None else jnp.take(k_scale, idx, axis=1)
+    vs = None if v_scale is None else jnp.take(v_scale, idx, axis=1)
+    return k, v, ks, vs
+
+
+def scatter_kv_pages(k_pages, v_pages, idx, k_val, v_val,
+                     k_scale=None, v_scale=None,
+                     ks_val=None, vs_val=None):
+    """Scatter whole pages back into paged pools — the spill tier's
+    host→device page-in write (the inverse of gather_kv_pages).
+
+    ``idx`` is the same fixed-width (P,) vector, padded with
+    ``num_pages`` (out of range) so pad rows DROP instead of landing in
+    page 0. Payload values are written verbatim — int8 codes and their
+    f32 scale leaves land exactly as gathered, which is what makes a
+    page-in bit-identical to the never-evicted run. Returns the
+    updated (k_pages, v_pages, k_scale, v_scale); the engine jits this
+    with the pool arguments donated so the write is in-place."""
+    k_pages = k_pages.at[:, idx].set(k_val.astype(k_pages.dtype),
+                                     mode="drop")
+    v_pages = v_pages.at[:, idx].set(v_val.astype(v_pages.dtype),
+                                     mode="drop")
+    if k_scale is not None and ks_val is not None:
+        k_scale = k_scale.at[:, idx].set(ks_val, mode="drop")
+        v_scale = v_scale.at[:, idx].set(vs_val, mode="drop")
+    return k_pages, v_pages, k_scale, v_scale
 
 
 @jax.tree_util.register_pytree_node_class

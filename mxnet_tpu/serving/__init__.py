@@ -70,6 +70,7 @@ from .scheduler import (Request, SlotScheduler, RejectedError,  # noqa: F401
                         TenantQuotaError, TERMINAL_STATUSES)
 from .page_pool import PagePool, PagePoolExhausted  # noqa: F401
 from .prefix_cache import PrefixCache  # noqa: F401
+from .host_tier import HostPagePool  # noqa: F401
 from .adapters import (AdapterPool, AdapterPoolExhausted,  # noqa: F401
                        merged_weights, random_lora)
 from .speculative import PromptLookupProposer, verify_tokens  # noqa: F401
@@ -87,7 +88,8 @@ __all__ = ["Request", "SlotScheduler", "RejectedError", "QueueFullError",
            "SheddingPolicy", "PagePool", "PagePoolExhausted",
            "AdapterPool", "AdapterPoolExhausted", "merged_weights",
            "random_lora",
-           "PrefixCache", "PromptLookupProposer", "FaultPlan",
+           "PrefixCache", "HostPagePool", "PromptLookupProposer",
+           "FaultPlan",
            "FaultError", "ReplicaFaultPlan",
            "filtered_logits", "sample_tokens", "slot_keys",
            "verify_tokens"]

@@ -87,7 +87,8 @@ from ..telemetry import cost as _cost
 from ..telemetry import ledger as _ledger
 from ..base import MXNetError
 from ..gluon.block import _trace_channel
-from ..models.kv_cache import PagedKVCache
+from ..models.kv_cache import (PagedKVCache, gather_kv_pages,
+                               scatter_kv_pages)
 from ..ndarray.ndarray import NDArray
 from ..telemetry import server as _tserver
 from ..telemetry import span
@@ -97,6 +98,7 @@ from ..parallel.mesh import (AXIS_TP, PartitionSpec, named_sharding,
                              serving_tp_mesh, shard_map_compat)
 from ..parallel.rules import serving_tp_rules
 from .adapters import AdapterPoolExhausted
+from .host_tier import HostPagePool
 from .page_pool import PagePool, PagePoolExhausted
 from .prefix_cache import PrefixCache
 from .sampling import sample_tokens, slot_keys
@@ -277,6 +279,61 @@ def _engine_metrics(eid):
             "tensor-parallel shards the unified dispatch runs across "
             "(head-wise shard_map over the tp mesh axis; 1 = "
             "unsharded)", _E),
+        "kv_spill_pages": c(
+            "serving_kv_spill_pages_total",
+            "KV pages whose payload moved device -> host RAM "
+            "(prefix-cache eviction spills plus whole-request "
+            "preemption swaps)", _E),
+        "kv_spill_bytes": c(
+            "serving_kv_spill_bytes_total",
+            "bytes admitted to the host spill tier", _E),
+        "kv_pagein_pages": c(
+            "serving_kv_pagein_pages_total",
+            "KV pages restored host -> device (radix hits on spilled "
+            "nodes plus preemption resumes)", _E),
+        "kv_pagein_bytes": c(
+            "serving_kv_pagein_bytes_total",
+            "bytes read back from the host tier by page-ins", _E),
+        "kv_host_evictions": c(
+            "serving_kv_host_evictions_total",
+            "spilled payloads LRU-dropped by the host tier to admit "
+            "newer spills (that state re-prefills if hit again)", _E),
+        "preempts": c(
+            "serving_preempt_total",
+            "running requests preempted by the shedding policy to "
+            "free a slot for more-urgent queued work", _E),
+        "preempt_resumed": c(
+            "serving_preempt_resumed_total",
+            "preempted requests spliced straight back into decode "
+            "from their swapped KV (no re-prefill)", _E),
+        "preempt_restarted": c(
+            "serving_preempt_restarted_total",
+            "preempted requests that fell back to the replay/restart "
+            "path (swap payload or prefix nodes gone) — output still "
+            "bit-identical, compute is not saved", _E),
+        "kv_spill_seconds": h(
+            "serving_kv_spill_seconds",
+            "wall time of one spill batch (device page gather + host "
+            "copy)", _E),
+        "kv_pagein_seconds": h(
+            "serving_kv_pagein_seconds",
+            "wall time of one page-in batch (host read + device page "
+            "scatter)", _E),
+        "kv_host_pages": g(
+            "serving_kv_host_pages",
+            "payload entries resident in the host spill tier", _E),
+        "kv_host_bytes": g(
+            "serving_kv_host_bytes",
+            "host-RAM bytes the spill tier currently holds", _E),
+        "prefix_resident_pages": g(
+            "serving_prefix_resident_pages",
+            "radix-tree nodes whose KV page is device-resident "
+            "(published even with the spill tier off, so tier "
+            "occupancy is always observable)", _E),
+        "prefix_spilled_pages": g(
+            "serving_prefix_spilled_pages",
+            "radix-tree nodes whose KV payload lives in the host "
+            "tier", _E),
     }
     _shed_family()                  # registered per-process; children
     _tenant_families()
@@ -387,7 +444,8 @@ class ServingEngine:
                  num_priorities=3, policy=None, max_retries=3,
                  retry_backoff_s=0.02, clock=None, adapter_pool=None,
                  tenant_quotas=None, kv_dtype=None,
-                 hbm_budget_bytes=None, tp=1, tp_devices=None):
+                 hbm_budget_bytes=None, host_kv_bytes=None, tp=1,
+                 tp_devices=None):
         self.model = model
         cfg = model.config
         self.num_slots = int(num_slots)
@@ -565,6 +623,70 @@ class ServingEngine:
         self.prefix_cache = PrefixCache(self.page_pool, page_size,
                                         budget_pages=extra) \
             if prefix_cache else None
+        # host-RAM KV spill tier (docs/SERVING.md "Tiered KV cache"):
+        # an evicted prefix page spills its payload (codes AND the int8
+        # scale leaves) to host RAM instead of vanishing, a radix hit
+        # on a spilled node pages it back in, and preemption swaps
+        # whole requests out through the same tier. All tier traffic
+        # runs OUTSIDE the traced dispatch — two tiny fixed-shape
+        # jitted page programs plus explicit transfers — so the
+        # unified program and steady_state_compiles never see it.
+        self._host_kv_bytes = None if host_kv_bytes is None \
+            else int(host_kv_bytes)
+        self.host_pool = None
+        if self._host_kv_bytes is not None:
+            if self.prefix_cache is None:
+                raise MXNetError("host_kv_bytes needs prefix_cache=True "
+                                 "— the spill tier is keyed by radix "
+                                 "nodes")
+            self.host_pool = HostPagePool(self._host_kv_bytes,
+                                          evict_cb=self._host_evict)
+            self.prefix_cache.evict_hook = self._spill_node
+            self.prefix_cache.pagein_hook = self._pagein_nodes
+            # tier transfer programs: ONE fixed index width (P = pages
+            # per slot) however many pages move. Gather pads its index
+            # with page 0 and the host slices the valid prefix after
+            # device_get; scatter pads with an out-of-range id that
+            # mode="drop" ignores. Gather must NOT donate (the pools
+            # live on); scatter donates them like every dispatch.
+            # under tp>1 the scatter pins out_shardings to the pools'
+            # own shardings: the donated outputs must come back in
+            # EXACTLY the layout the dispatch expects (XLA would
+            # otherwise return a spec-normalized NamedSharding that
+            # misses the dispatch cache key). tp=1 must NOT pin — the
+            # pool chain is uncommitted end to end, and committing it
+            # here would mint a second pjit entry in every downstream
+            # page program.
+            pin = self._tp > 1
+            if self._quant:
+                def _tier_gather_q(kp, vp, ks, vs, idx):
+                    return gather_kv_pages(kp, vp, idx, ks, vs)
+
+                def _tier_scatter_q(kp, vp, ks, vs, idx, kv, vv,
+                                    ksv, vsv):
+                    return scatter_kv_pages(kp, vp, idx, kv, vv,
+                                            ks, vs, ksv, vsv)
+
+                self._tier_gather_fn = jax.jit(_tier_gather_q)
+                self._tier_scatter_fn = jax.jit(
+                    _tier_scatter_q, donate_argnums=(0, 1, 2, 3),
+                    out_shardings=(
+                        (self._kp.sharding, self._vp.sharding,
+                         self._ks.sharding, self._vs.sharding)
+                        if pin else None))
+            else:
+                def _tier_gather_f(kp, vp, idx):
+                    return gather_kv_pages(kp, vp, idx)[:2]
+
+                def _tier_scatter_f(kp, vp, idx, kv, vv):
+                    return scatter_kv_pages(kp, vp, idx, kv, vv)[:2]
+
+                self._tier_gather_fn = jax.jit(_tier_gather_f)
+                self._tier_scatter_fn = jax.jit(
+                    _tier_scatter_f, donate_argnums=(0, 1),
+                    out_shardings=(
+                        (self._kp.sharding, self._vp.sharding)
+                        if pin else None))
         # per-slot page tables are HOST state now (page-table surgery at
         # admission); uploaded with each dispatch
         self._table_host = np.zeros((B, P), np.int32)
@@ -684,6 +806,7 @@ class ServingEngine:
         weakref.finalize(self, _tserver.clear_degraded,
                          f"engine{self._eid}")
         self._evictions_seen = 0
+        self._host_evictions_seen = 0
         self._set_pool_gauges()
         # live introspection: /statusz shows this engine's config +
         # occupancy, the flight-recorder watchdog probes its progress
@@ -762,6 +885,20 @@ class ServingEngine:
             "kv_bytes_per_token": float(
                 m["kv_bytes_per_token"].value),
             "tp_shards": int(m["tp_shards"].value),
+            "kv_spill_pages": int(m["kv_spill_pages"].value),
+            "kv_spill_bytes": int(m["kv_spill_bytes"].value),
+            "kv_pagein_pages": int(m["kv_pagein_pages"].value),
+            "kv_pagein_bytes": int(m["kv_pagein_bytes"].value),
+            "kv_host_evictions": int(m["kv_host_evictions"].value),
+            "kv_host_pages": int(m["kv_host_pages"].value),
+            "kv_host_bytes": int(m["kv_host_bytes"].value),
+            "prefix_resident_pages": int(
+                m["prefix_resident_pages"].value),
+            "prefix_spilled_pages": int(
+                m["prefix_spilled_pages"].value),
+            "preempts": int(m["preempts"].value),
+            "preempt_resumed": int(m["preempt_resumed"].value),
+            "preempt_restarted": int(m["preempt_restarted"].value),
         }
 
     def tenant_stats(self):
@@ -893,10 +1030,20 @@ class ServingEngine:
         pc = self.prefix_cache
         if pc is not None:
             m["prefix_cache_pages"].set(pc.num_pages)
+            m["prefix_resident_pages"].set(pc.num_resident)
+            m["prefix_spilled_pages"].set(pc.num_spilled)
             delta = pc.evicted_pages - self._evictions_seen
             if delta:
                 m["prefix_evicted_pages"].inc(delta)
                 self._evictions_seen = pc.evicted_pages
+        hp = self.host_pool
+        if hp is not None:
+            m["kv_host_pages"].set(hp.num_entries)
+            m["kv_host_bytes"].set(hp.bytes_used)
+            delta = hp.evictions - self._host_evictions_seen
+            if delta:
+                m["kv_host_evictions"].inc(delta)
+                self._host_evictions_seen = hp.evictions
         pool = self.adapter_pool
         if pool is not None:
             m["adapter_resident"].set(pool.num_resident)
@@ -939,6 +1086,7 @@ class ServingEngine:
                 "kv_dtype": self.kv_dtype,
                 "kv_page_bytes": self.page_pool.page_bytes,
                 "hbm_budget_bytes": self._hbm_budget,
+                "host_kv_bytes": self._host_kv_bytes,
                 "steady_state": self._steady,
                 "adapter_pool": self.adapter_pool is not None,
                 "adapter_slots": self.adapter_pool.slots
@@ -960,6 +1108,16 @@ class ServingEngine:
                                "slot_scalars", "logits"],
             },
             "admission_capacity": self.admission_capacity_estimate(),
+            "kv_tier": None if self.host_pool is None else {
+                "host_budget_bytes": self.host_pool.budget_bytes,
+                "host_bytes_used": self.host_pool.bytes_used,
+                "host_entries": self.host_pool.num_entries,
+                "host_evictions": self.host_pool.evictions,
+                "resident_pages": self.prefix_cache.num_resident,
+                "spilled_pages": self.prefix_cache.num_spilled,
+                "spilled_total": self.prefix_cache.spilled_pages,
+                "paged_in_total": self.prefix_cache.paged_in_pages,
+            },
             "robustness": {
                 "degraded": self._degraded,
                 "draining": self._draining,
@@ -1070,6 +1228,11 @@ class ServingEngine:
         if pc is not None:
             out["prefix_cache_pages"] = _ledger.Detail(
                 pc.num_pages * self.page_pool.page_bytes)
+        if self.host_pool is not None:
+            # host-tier bytes are NOT HBM: a Detail row so /memz shows
+            # the spill tier next to the device figures it relieves,
+            # without polluting the accounted device total
+            out["host_kv"] = _ledger.Detail(self.host_pool.bytes_used)
         return out
 
     # -- admission control -------------------------------------------------
@@ -1267,6 +1430,7 @@ class ServingEngine:
             if slot is None:
                 return False
             req = self._release_slot(slot)
+        self._drop_swap(req)
         req.t_finish = self._clock()
         req.status = "cancelled"
         self._metrics["requests_cancelled"].inc()
@@ -1348,6 +1512,9 @@ class ServingEngine:
             out.append(req)
         out.sort(key=lambda r: r._seq if r._seq is not None else -1)
         for req in out:
+            # a swap payload cannot travel to another replica — drop
+            # it; the adopter restarts via the replay path instead
+            self._drop_swap(req)
             req.status = "exported"
             telemetry.request_log.end(
                 req.id, self._eid, "migrated",
@@ -1404,6 +1571,16 @@ class ServingEngine:
             # backlog this tick's dispatch actually leaves queued, not the
             # pre-admission spike that free slots are about to absorb.
             self.policy.on_step(self, now)
+            if self.host_pool is not None \
+                    and hasattr(self.policy, "preempt_victim"):
+                # whole-request swap: with every slot busy and strictly
+                # more-urgent work queued, swap the least-urgent running
+                # request out through the host tier — its slot admits
+                # the urgent request next tick, and it resumes
+                # bit-identically later (page-in or replay)
+                victim = self.policy.preempt_victim(self)
+                if victim is not None:
+                    self._preempt_slot(victim)
         self._set_load_gauges()
         if self.scheduler.num_active:
             try:
@@ -1501,6 +1678,7 @@ class ServingEngine:
         """A queued request whose deadline passed before admission:
         terminal `rejected(deadline)` — no tokens were produced, no
         slot or page was ever touched."""
+        self._drop_swap(req)
         req.status = "shed"
         req.t_finish = self._clock()
         self._shed_inc("deadline_queued", req.priority, req.tenant)
@@ -1531,8 +1709,13 @@ class ServingEngine:
         """Page-pool invariant audit with this engine's full lease map:
         every mapped slot's table row, any extra lease rows registered
         in `audit_extra_leases` (the fault-injection harness registers
-        pages it holds), and the prefix cache's member pages. Returns
-        the violation list ([] = clean)."""
+        pages it holds), and the prefix cache's member pages. With the
+        host tier on, the CROSS-TIER check rides along: the tier's
+        node keys must match the tree's spilled keypaths exactly, its
+        swap keys must belong to queued preempted requests, and the
+        host pool's own byte accounting must balance — no page may
+        leak across tiers in either direction. Returns the violation
+        list ([] = clean)."""
         leases = [self._table_host[s] for s in range(self.num_slots)
                   if self._mapped[s]]
         leases.extend(self.audit_extra_leases)
@@ -1547,9 +1730,41 @@ class ServingEngine:
             scales = np.maximum(
                 np.abs(np.asarray(self._ks)).max(axis=(0, 2)),
                 np.abs(np.asarray(self._vs)).max(axis=(0, 2)))
-        return self.page_pool.audit(leases=leases, members=members,
-                                    scales=scales,
-                                    raise_on_error=raise_on_error)
+        host_keys = spilled_keys = None
+        extra = []
+        if self.host_pool is not None:
+            spilled_keys = set(self.prefix_cache.spilled_keypaths())
+            # swap payloads are legitimate host entries only while a
+            # queued preempted request references them (the stale
+            # inverse — a swap record whose payload the host LRU
+            # dropped — is fine: resume detects it and restarts)
+            swaps = {("req", r.id)
+                     for r in self.scheduler.queued_requests()
+                     if getattr(r, "swap", None) is not None
+                     and r.swap.get("key") is not None}
+            host_keys = set()
+            for key in self.host_pool.keys():
+                kind = key[0] if isinstance(key, tuple) and key else None
+                if kind == "node":
+                    host_keys.add(key[1])
+                elif kind == "req":
+                    if key not in swaps:
+                        extra.append(
+                            f"host tier holds swap payload {key!r} "
+                            "with no queued preempted request "
+                            "(leaked)")
+                else:
+                    extra.append(
+                        f"host tier holds unknown key {key!r}")
+            extra.extend(self.host_pool.audit())
+        out = self.page_pool.audit(leases=leases, members=members,
+                                   scales=scales, host_keys=host_keys,
+                                   spilled_keys=spilled_keys)
+        out.extend(extra)
+        if out and raise_on_error:
+            raise MXNetError("page pool audit failed: "
+                             + "; ".join(out))
+        return out
 
     @thread_safe
     def audit_adapters(self, raise_on_error=False):
@@ -1587,6 +1802,7 @@ class ServingEngine:
         `max_retries` times — it is poison as far as the engine can
         tell. Terminal `failed(error)`; the engine keeps serving
         everyone else."""
+        self._drop_swap(req)
         req.status = "failed"
         req.t_finish = self._clock()
         self._metrics["requests_failed"].inc()
@@ -1860,6 +2076,318 @@ class ServingEngine:
             self.page_pool.free(self.page_pool.decref(row))
         self._mapped[slot] = False
 
+    # -- host KV tier (docs/SERVING.md "Tiered KV cache") ------------------
+    def _tier_gather(self, pages):
+        """Device -> host payload read: the fixed-width jitted page
+        gather (index padded with page 0, one compiled program however
+        many pages move) plus one device_get. Returns one payload dict
+        per page — int8 codes AND the per-page scale leaves travel
+        together, so a later page-in restores the page verbatim and
+        every future read of it is bit-identical."""
+        P = self._pages_per_slot
+        out = []
+        for i in range(0, len(pages), P):
+            blk = [int(p) for p in pages[i:i + P]]
+            idx = np.zeros(P, np.int32)
+            idx[:len(blk)] = blk
+            if self._quant:
+                k, v, ks, vs = self._tier_gather_fn(
+                    self._kp, self._vp, self._ks, self._vs,
+                    jnp.asarray(idx))
+                k, v, ks, vs = jax.device_get((k, v, ks, vs))
+            else:
+                k, v = self._tier_gather_fn(self._kp, self._vp,
+                                            jnp.asarray(idx))
+                k, v = jax.device_get((k, v))
+                ks = vs = None
+            for j in range(len(blk)):
+                # copy out of the gathered block: a view would pin the
+                # whole (L, P, ...) buffer in host RAM per page
+                pl = {"k": np.ascontiguousarray(k[:, j]),
+                      "v": np.ascontiguousarray(v[:, j])}
+                if ks is not None:
+                    pl["ks"] = np.ascontiguousarray(ks[:, j])
+                    pl["vs"] = np.ascontiguousarray(vs[:, j])
+                out.append(pl)
+        return out
+
+    def _tier_scatter(self, items):
+        """Host -> device page-in write for `items` = [(page_id,
+        payload)]: assemble the fixed-width value block, upload it, and
+        run the donated jitted scatter (pad rows target an out-of-range
+        page id and drop). Scale leaves are written with the codes, so
+        a paged-in int8 page needs no re-quantization — and no
+        _zero_scales pass — to read back exactly."""
+        P = self._pages_per_slot
+        L, _, S, H, Dh = self._kp.shape
+        for i in range(0, len(items), P):
+            blk = items[i:i + P]
+            idx = np.full(P, self.page_pool.num_pages, np.int32)
+            kval = np.zeros((L, P, S, H, Dh), self._kp.dtype)
+            vval = np.zeros_like(kval)
+            ksv = vsv = None
+            if self._quant:
+                ksv = np.zeros((L, P, H), np.float32)
+                vsv = np.zeros((L, P, H), np.float32)
+            for j, (page, pl) in enumerate(blk):
+                idx[j] = int(page)
+                kval[:, j] = pl["k"]
+                vval[:, j] = pl["v"]
+                if self._quant:
+                    ksv[:, j] = pl["ks"]
+                    vsv[:, j] = pl["vs"]
+            if self._quant:
+                (self._kp, self._vp, self._ks,
+                 self._vs) = self._tier_scatter_fn(
+                    self._kp, self._vp, self._ks, self._vs,
+                    jnp.asarray(idx),
+                    self._rep(jnp.asarray(kval)),
+                    self._rep(jnp.asarray(vval)),
+                    self._rep(jnp.asarray(ksv)),
+                    self._rep(jnp.asarray(vsv)))
+            else:
+                self._kp, self._vp = self._tier_scatter_fn(
+                    self._kp, self._vp, jnp.asarray(idx),
+                    self._rep(jnp.asarray(kval)),
+                    self._rep(jnp.asarray(vval)))
+
+    def _spill_node(self, keypath, page):
+        """PrefixCache evict_hook: offer one evicted node's payload to
+        the host tier (gather runs BEFORE the cache frees the device
+        page). False — payload not taken, host budget unmeetable —
+        makes the cache fall back to plain discard."""
+        t0 = self._clock()
+        key = ("node", keypath)
+        payload = self._tier_gather([int(page)])[0]
+        if not self.host_pool.put(key, payload):
+            return False
+        m = self._metrics
+        m["kv_spill_pages"].inc()
+        m["kv_spill_bytes"].inc(self.host_pool.entry_bytes(key))
+        m["kv_spill_seconds"].observe(self._clock() - t0)
+        return True
+
+    def _pagein_nodes(self, items):
+        """PrefixCache pagein_hook: restore `items` = [(keypath,
+        fresh_page)] from the host tier in one batched scatter. Each
+        payload is checked out (pinned) for the duration and released
+        with drop=True only once the scatter landed — on any failure
+        the entries survive for the next attempt."""
+        t0 = self._clock()
+        taken, ok, nbytes = [], False, 0
+        try:
+            payloads = []
+            for kp, _ in items:
+                key = ("node", kp)
+                payloads.append(self.host_pool.checkout(key))
+                taken.append(key)
+                nbytes += self.host_pool.entry_bytes(key)
+            self._tier_scatter(
+                [(pg, pl) for (_, pg), pl in zip(items, payloads)])
+            ok = True
+        finally:
+            for key in taken:
+                self.host_pool.release(key, drop=ok)
+        m = self._metrics
+        m["kv_pagein_pages"].inc(len(items))
+        m["kv_pagein_bytes"].inc(nbytes)
+        m["kv_pagein_seconds"].observe(self._clock() - t0)
+
+    def _host_evict(self, key):
+        """HostPagePool evict_cb: the tier wants to LRU-drop `key` to
+        admit a newer spill. Node payloads go through the prefix
+        cache's drop_spilled (vetoed while the node still anchors a
+        spilled subtree); swap payloads are always droppable — the
+        preempted request's resume detects the loss and falls back to
+        the replay/restart path, which is bit-identical anyway."""
+        kind, val = key
+        if kind == "node":
+            return self.prefix_cache.drop_spilled(val)
+        return True
+
+    def _drop_swap(self, req):
+        """Discard a preempted request's swap record and host payload
+        (the request went terminal, migrated, or its record went
+        stale). If it ever runs again it restarts via the replay
+        path. No-op for requests that were never preempted."""
+        swap = getattr(req, "swap", None)
+        if swap is None:
+            return
+        req.swap = None
+        key = swap.get("key")
+        if key is not None and self.host_pool is not None \
+                and key in self.host_pool:
+            self.host_pool.discard(key)
+
+    def _preempt_slot(self, slot):
+        """Whole-request swap under overload: gather the victim's
+        EXCLUSIVE pages (the shared prefix stays in the radix tree) to
+        one host-tier payload, release the slot and every page lease,
+        and requeue the request unblamed at the front of its class
+        with a swap record naming its prefix nodes and slot scalars.
+        If the host tier cannot take the payload the request still
+        yields its slot, but will restart via the replay path instead
+        of resuming. Either way the continuation is bit-identical —
+        swapping just skips the re-prefill compute."""
+        req = self.scheduler.request_at(slot)
+        S, P = self.page_size, self._pages_per_slot
+        pc = self.prefix_cache
+        length = int(self._lengths[slot])
+        n_used = min(P, -(-length // S))
+        row = [int(p) for p in self._table_host[slot][:n_used]]
+        member = pc.member_mask()
+        n_shared = 0
+        for p in row:
+            if not member[p]:
+                break
+            n_shared += 1
+        excl = row[n_shared:]
+        m = self._metrics
+        m["preempts"].inc()
+        key = ("req", req.id) if excl else None
+        swapped = True
+        if excl:
+            t0 = self._clock()
+            pls = self._tier_gather(excl)
+            payload = {name: np.stack([pl[name] for pl in pls])
+                       for name in pls[0]}
+            swapped = self.host_pool.put(key, payload)
+            if swapped:
+                m["kv_spill_pages"].inc(len(excl))
+                m["kv_spill_bytes"].inc(
+                    self.host_pool.entry_bytes(key))
+                m["kv_spill_seconds"].observe(self._clock() - t0)
+        nodes = [pc._by_page.get(p) for p in row[:n_shared]]
+        if swapped and all(n is not None for n in nodes):
+            req.swap = {
+                "key": key,
+                "nodes": nodes,
+                "n_excl": len(excl),
+                "length": length,
+                "cur_tok": int(self._cur_tok[slot]),
+                "remaining": int(self._remaining[slot]),
+                "counters": int(self._counters[slot]),
+            }
+        else:
+            if swapped and key is not None:
+                self.host_pool.discard(key)
+            m["preempt_restarted"].inc()
+        self._release_slot(slot)
+        self.scheduler.requeue(req)
+        req.status = "queued"
+        telemetry.request_log.event(
+            req.id, self._eid, "preempted", slot=slot,
+            swapped=req.swap is not None,
+            tokens=len(req.output_tokens))
+        self._set_pool_gauges()
+
+    def _try_resume(self, slot, req):
+        """Splice a swapped request straight back into decode: re-lease
+        its shared prefix nodes (paging spilled ones back in), restore
+        its exclusive pages from the swap payload into fresh device
+        pages, and rebuild the slot scalars from the swap record — no
+        prefill, no replay. Returns False when the record went stale
+        (payload LRU-dropped, a prefix node discarded); the caller
+        falls back to the plain restart. PagePoolExhausted mid-resume
+        rolls every lease taken here back and propagates — the
+        supervisor requeues unblamed with the swap kept."""
+        swap = req.swap
+        pc = self.prefix_cache
+        key = swap["key"]
+        nodes = swap["nodes"]
+        if (key is not None and key not in self.host_pool) \
+                or any(n.dead for n in nodes):
+            return False
+        P = self._pages_per_slot
+        n_shared = len(nodes)
+        n_excl = int(swap["n_excl"])
+        t0 = self._clock()
+        m = self._metrics
+        taken, ok, payload, nbytes = [], False, None, 0
+        try:
+            if key is not None:
+                # pin the payload FIRST: the reclaim below may spill
+                # into the host tier and LRU-pressure it out otherwise
+                payload = self.host_pool.checkout(key)
+                nbytes = self.host_pool.entry_bytes(key)
+            resident = [n for n in nodes if not n.spilled]
+            spilled = [n for n in nodes if n.spilled]
+            self.page_pool.adopt([n.page for n in resident])
+            taken.extend(n.page for n in resident)
+            if spilled:
+                pin = pc._pagein(
+                    [(pc._keypath(n), n) for n in spilled],
+                    next(pc._clock))
+                taken.extend(pin)
+                if len(pin) < len(spilled):
+                    raise PagePoolExhausted(
+                        f"page-in of {len(spilled)} spilled prefix "
+                        f"pages restored {len(pin)} — resume of "
+                        f"request {req.id} waits for pages to drain")
+            need = P - n_shared
+            if self.page_pool.num_free < need:
+                pc.reclaim(need)
+            fresh = self.page_pool.alloc(need)
+            taken.extend(fresh)
+            if self._quant and fresh:
+                # recycled pages beyond the payload rows still need
+                # zeroed scales before decode's monotone max-update
+                idx = np.full(P, self.page_pool.num_pages, np.int32)
+                idx[:len(fresh)] = fresh
+                self._ks, self._vs = self._zero_scales_fn(
+                    self._ks, self._vs, jnp.asarray(idx))
+            if n_excl:
+                items = []
+                for j in range(n_excl):
+                    pl = {"k": payload["k"][j], "v": payload["v"][j]}
+                    if self._quant:
+                        pl["ks"] = payload["ks"][j]
+                        pl["vs"] = payload["vs"][j]
+                    items.append((fresh[j], pl))
+                self._tier_scatter(items)
+            ok = True
+        except BaseException:
+            if taken:
+                pc.release(taken)
+            raise
+        finally:
+            if payload is not None:
+                self.host_pool.release(key, drop=ok)
+        if n_excl:
+            m["kv_pagein_pages"].inc(n_excl)
+            m["kv_pagein_bytes"].inc(nbytes)
+            m["kv_pagein_seconds"].observe(self._clock() - t0)
+        self._table_host[slot] = np.asarray(
+            [n.page for n in nodes] + fresh, np.int32)
+        self._mapped[slot] = True
+        self._pending[slot] = None
+        self._replay[slot] = None
+        self._base[slot] = len(req.output_tokens)
+        self._lengths[slot] = swap["length"]
+        self._cur_tok[slot] = swap["cur_tok"]
+        self._remaining[slot] = swap["remaining"]
+        self._counters[slot] = swap["counters"]
+        self._seeds[slot] = req.seed
+        self._temp[slot] = req.temperature
+        self._top_k[slot] = req.top_k
+        self._top_p[slot] = req.top_p
+        self._do_sample[slot] = req.do_sample
+        self._eos[slot] = -1 if req.eos_token_id is None \
+            else req.eos_token_id
+        self._done[slot] = False
+        if self.speculative:
+            self._hist[slot] = [int(t) for t in req.prompt] \
+                + [int(t) for t in req.output_tokens]
+        req.swap = None
+        req.status = "running"
+        self._sync_slot(slot)
+        m["preempt_resumed"].inc()
+        telemetry.request_log.event(
+            req.id, self._eid, "resumed_swap", slot=slot,
+            tokens=len(req.output_tokens))
+        self._set_pool_gauges()
+        return True
+
     # -- admission ---------------------------------------------------------
     @supervised("adapter/page leases taken here are rolled back by "
                 "_on_admit_fault (slot state parked, leases released, "
@@ -1894,6 +2422,20 @@ class ServingEngine:
             self._adapter_of[slot] = req.adapter_id \
                 if req.adapter_id not in (None, 0) else None
             self._aslot[slot] = aslot
+        if req.swap is not None:
+            # preempted request: splice straight back into decode from
+            # its swapped KV — no prefill. A stale swap (payload
+            # LRU-dropped from the host tier, prefix nodes discarded)
+            # falls through to the plain restart below, which replays
+            # to the same output; PagePoolExhausted mid-resume
+            # propagates as backpressure with the swap kept for a
+            # later retry.
+            if self._try_resume(slot, req):
+                return None
+            self._drop_swap(req)
+            self._metrics["preempt_restarted"].inc()
+            telemetry.request_log.event(req.id, self._eid,
+                                        "swap_stale")
         # a prefix-cache hit seeds the chunk cursor past the shared
         # pages: length starts at the cached offset and the queue holds
         # only the uncached tail (>= 1 token — a fully cached prompt is

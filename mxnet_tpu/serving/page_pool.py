@@ -196,7 +196,7 @@ class PagePool:
 
     @thread_safe
     def audit(self, leases=None, members=(), raise_on_error=False,
-              scales=None):
+              scales=None, host_keys=None, spilled_keys=None):
         """O(pages) invariant check — the supervisor runs this after
         every caught dispatch fault, and tests run it at drain.
 
@@ -213,11 +213,28 @@ class PagePool:
         pool page, finite and non-negative everywhere — a NaN/inf or
         negative scale is corrupted quantization state that would
         silently poison every future read of that page.
+        host_keys / spilled_keys: the cross-TIER check (give both or
+        neither). host_keys = radix-node keys currently held by the
+        host spill tier; spilled_keys = keypaths of the radix tree's
+        spilled nodes. The sets must match exactly: a host payload
+        with no spilled node is a leaked host page (unreachable, yet
+        burning budget), a spilled node with no payload is lost state
+        a match() would page garbage in for.
 
         Returns the list of violation strings ([] = clean); with
         raise_on_error=True a non-empty list raises MXNetError instead.
         """
         v = []
+        if host_keys is not None or spilled_keys is not None:
+            host_keys = set(host_keys or ())
+            spilled_keys = set(spilled_keys or ())
+            for k in sorted(host_keys - spilled_keys, key=repr):
+                v.append(f"host tier holds payload for {k!r} but no "
+                         "spilled tree node references it (leaked "
+                         "across tiers)")
+            for k in sorted(spilled_keys - host_keys, key=repr):
+                v.append(f"spilled tree node {k!r} has no host-tier "
+                         "payload (lost state)")
         if scales is not None:
             scales = np.asarray(scales)
             if scales.shape != (self.num_pages,):

@@ -66,12 +66,20 @@ class SheddingPolicy:
         (ShedError reason="tenant_share") — one tenant's burst must
         not starve the others of queue capacity. None disables the
         signal; it only ever fires for requests that carry a tenant.
+    preempt: while OVERLOADED with every slot busy and more-urgent
+        work queued, allow the engine to preempt the least-urgent
+        running request — its exclusive KV pages swap to the host
+        tier and it resumes bit-identically later (engine
+        `_preempt_slot`; needs `host_kv_bytes` on the engine). Off by
+        default: preemption beats shedding only when the host tier
+        exists to keep the partial work.
     """
 
     def __init__(self, ttft_slo_ms=None, queue_low=None, queue_high=None,
                  shed_priority_floor=0, min_ttft_samples=8,
                  deadline_headroom=1.0, degrade_after=3,
-                 recover_after=6, tenant_queue_share=None):
+                 recover_after=6, tenant_queue_share=None,
+                 preempt=False):
         self.ttft_slo_ms = ttft_slo_ms
         self.queue_low = queue_low
         self.queue_high = queue_high
@@ -80,6 +88,7 @@ class SheddingPolicy:
         self.deadline_headroom = float(deadline_headroom)
         self.degrade_after = int(degrade_after)
         self.recover_after = int(recover_after)
+        self.preempt = bool(preempt)
         self.tenant_queue_share = None if tenant_queue_share is None \
             else float(tenant_queue_share)
         if self.tenant_queue_share is not None \
@@ -151,6 +160,39 @@ class SheddingPolicy:
             return "downgrade", "elevated"
         return "admit", None
 
+    def preempt_victim(self, engine):
+        """Pick one running slot to swap out for more-urgent queued
+        work, or None. Fires only when `preempt` is on, the engine is
+        OVERLOADED (uses the level from this step's assess — call
+        after on_step), every slot is busy, and some queued request is
+        STRICTLY more urgent than some running one. The victim is the
+        least-urgent running request (largest priority number, then
+        fewest generated tokens — minimal swapped state); requests
+        below the shed floor, mid-replay, or already carrying a
+        pending restart plan are never preempted."""
+        if not self.preempt or self.level < 2:
+            return None
+        sched = engine.scheduler
+        if sched.num_free > 0:
+            return None
+        queued = [r.priority for r in sched.queued_requests()]
+        if not queued:
+            return None
+        best_queued = min(queued)
+        victim = None
+        for slot in sched.active_slots:
+            req = sched.request_at(slot)
+            if req is None or engine._pending[slot] is not None:
+                continue             # mid-prefill/replay: let it land
+            if req.priority <= self.shed_priority_floor:
+                continue
+            if req.priority <= best_queued:
+                continue             # only yield to strictly more urgent
+            if victim is None or (req.priority, -len(req.output_tokens)) \
+                    > (victim[1].priority, -len(victim[1].output_tokens)):
+                victim = (slot, req)
+        return None if victim is None else victim[0]
+
     def on_step(self, engine, now):
         """Per-step degradation tick: latch after `degrade_after`
         consecutive overloaded assessments, clear after
@@ -179,6 +221,7 @@ class SheddingPolicy:
             "degrade_after": self.degrade_after,
             "recover_after": self.recover_after,
             "tenant_queue_share": self.tenant_queue_share,
+            "preempt": self.preempt,
             "level": self.level,
             "downgrades": self.downgrades,
         }

@@ -132,6 +132,13 @@ class Request:
         # rides the Request through export/adopt migration, which is
         # how a mid-stream failover re-attaches the live stream.
         self.stream = None
+        # whole-request swap record (serving/engine.py _preempt_slot):
+        # while a PREEMPTED request waits in queue, its exclusive KV
+        # pages live in the host tier under ("req", id) and this dict
+        # carries what _try_resume needs to splice them back (shared
+        # prefix nodes, decode cursor, counters). None = not swapped;
+        # resume-or-restart both clear it.
+        self.swap = None
 
     @property
     def prompt_len(self):

@@ -10,3 +10,9 @@ class Worker:
         pages = self.pool.alloc(n)      # leaks if prepare() raises
         self.meta = prepare(pages)      # noqa: F821 — fixture
         return pages
+
+    def pagein(self, key):
+        payload = self.tier.checkout(key)   # pin leaks if land() raises
+        land(payload)                       # noqa: F821 — fixture
+        self.tier.release(key, drop=True)   # happy path only
+        return payload

@@ -52,3 +52,21 @@ class Careful:
             return self.pool.used
         finally:
             self._lock.release()
+
+    def pagein_covered(self, key):
+        ok = False
+        try:
+            payload = self.tier.checkout(key)
+            land(payload)               # noqa: F821 — fixture
+            ok = True
+        finally:
+            self.tier.release(key, drop=ok)
+        return payload
+
+    def drop_covered(self, key):
+        try:
+            payload = self.tier.checkout(key)
+            return consume(payload)     # noqa: F821 — fixture
+        except Exception:
+            self.tier.discard(key)      # discard counts as release
+            raise

@@ -1,0 +1,435 @@
+"""ISSUE 16: tiered KV cache — host-RAM spill tier with page-in on
+radix hit and whole-request swap under overload.
+
+Layers under test. `HostPagePool` units: byte budget, LRU order,
+checkout pins vs eviction, veto callback, audit. The tier transfer
+path: one jitted fixed-width gather and one donated scatter must
+round-trip a page BIT-EXACTLY — fp32 slabs, and int8 codes AND their
+f32 dequant scale leaves. End-to-end exactness: a radix hit on a
+SPILLED node pages the payload back in and the request's output is
+bit-identical to a never-evicted run (same seeds, same chunk grid);
+preempt-and-resume under an overloaded shedding policy splices the
+swapped request straight back into decode, bit-identical to a
+fault-free solo run, and the restart fallback (host tier too small to
+hold the swap) replays to the same output. tp=2: paged-in pages land
+with the pool's head-sharded layout intact. Compile discipline: spill
+and page-in traffic lives OUTSIDE the unified dispatch — churn that
+spills and restores pages compiles NOTHING after mark_warm(), and
+each tier program holds exactly ONE jit cache entry (the padded
+fixed-width index idiom).
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.models import GPT2Config, GPT2ForCausalLM
+from mxnet_tpu.serving import (HostPagePool, Request, ServingEngine,
+                               SheddingPolicy)
+from mxnet_tpu.telemetry import cost as _cost
+
+_NET = {}
+
+_SAMPLED = dict(do_sample=True, temperature=0.8, top_k=20, top_p=0.95)
+
+
+def _tiny(vocab=97, layers=2, units=32, heads=2, max_len=64, seed=3):
+    key = (vocab, layers, units, heads, max_len, seed)
+    if key not in _NET:
+        cfg = GPT2Config(vocab_size=vocab, units=units, num_layers=layers,
+                         num_heads=heads, max_length=max_len, dropout=0.0,
+                         attention_dropout=0.0)
+        net = GPT2ForCausalLM(cfg)
+        mx.rng.seed(seed)
+        net.initialize(mx.init.Normal(0.05))
+        _NET[key] = (net, cfg)
+    return _NET[key]
+
+
+def _engine(net, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_length", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("attn_impl", "xla")
+    kw.setdefault("prefix_cache", True)
+    return ServingEngine(net, **kw)
+
+
+class Tick:
+    """Injectable engine clock — deterministic preemption schedules."""
+
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+def _pl(nbytes, fill=1):
+    h = nbytes // 2
+    return {"k": np.full(h, fill, np.uint8),
+            "v": np.full(nbytes - h, fill, np.uint8)}
+
+
+# ---------------------------------------------------------------------------
+# HostPagePool units
+# ---------------------------------------------------------------------------
+
+def test_host_pool_budget_and_lru_eviction():
+    hp = HostPagePool(100)
+    assert hp.put("a", _pl(40))
+    assert hp.put("b", _pl(40))
+    assert hp.bytes_used == 80 and hp.num_entries == 2
+    assert hp.entry_bytes("a") == 40
+    # third 40-byte entry forces the OLDEST out
+    assert hp.put("c", _pl(40))
+    assert hp.keys() == ["b", "c"]
+    assert hp.evictions == 1 and hp.bytes_used == 80
+    # an entry that can never fit is rejected, pool untouched
+    assert not hp.put("big", _pl(200))
+    assert hp.rejected == 1 and hp.keys() == ["b", "c"]
+    assert hp.audit() == []
+
+
+def test_host_pool_duplicate_put_raises():
+    hp = HostPagePool(100)
+    assert hp.put("a", _pl(10))
+    with pytest.raises(MXNetError):
+        hp.put("a", _pl(10))
+
+
+def test_host_pool_checkout_pins_against_eviction():
+    hp = HostPagePool(100)
+    hp.put("a", _pl(40))
+    hp.put("b", _pl(40))
+    got = hp.checkout("a")          # pinned AND freshened in LRU order
+    assert got["k"].nbytes + got["v"].nbytes == 40
+    assert hp.put("c", _pl(40))     # must evict "b": "a" is pinned
+    assert "a" in hp and "b" not in hp
+    hp.release("a", drop=True)      # lease back, payload landed: gone
+    assert "a" not in hp
+    assert hp.audit() == []
+
+
+def test_host_pool_lease_discipline_raises():
+    hp = HostPagePool(100)
+    hp.put("a", _pl(10))
+    with pytest.raises(MXNetError):
+        hp.checkout("missing")
+    with pytest.raises(MXNetError):
+        hp.release("a")             # never checked out
+    hp.checkout("a")
+    with pytest.raises(MXNetError):
+        hp.discard("a")             # pinned
+    hp.release("a")
+    assert hp.discard("a")
+    assert not hp.discard("a")      # unknown key: False, no raise
+    assert hp.audit() == []
+
+
+def test_host_pool_evict_cb_veto_blocks_admission():
+    hp = HostPagePool(50, evict_cb=lambda key: key != "keep")
+    hp.put("keep", _pl(40))
+    assert not hp.put("new", _pl(40))   # only victim is vetoed
+    assert hp.rejected == 1 and "keep" in hp
+    assert hp.audit() == []
+
+
+def test_host_kv_requires_prefix_cache():
+    net, _ = _tiny()
+    with pytest.raises(MXNetError):
+        _engine(net, prefix_cache=False, host_kv_bytes=1 << 20)
+
+
+# ---------------------------------------------------------------------------
+# tier transfer path: gather -> host -> scatter round-trips bit-exactly
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_tier_roundtrip_bit_exact(kv_dtype):
+    """Spill a page the engine actually wrote and page it into a fresh
+    page: codes AND (for int8) the per-page scale leaves must come back
+    verbatim — the exactness contract every later read relies on."""
+    net, _ = _tiny()
+    eng = _engine(net, kv_dtype=kv_dtype, host_kv_bytes=1 << 22)
+    eng.serve([Request(list(range(1, 26)), 4, request_id="w")])
+    member = np.nonzero(eng.prefix_cache.member_mask())[0]
+    assert member.size >= 2
+    src = [int(p) for p in member[:2]]
+    payloads = eng._tier_gather(src)
+    fresh = eng.page_pool.alloc(len(src))
+    eng._tier_scatter(list(zip(fresh, payloads)))
+    kp, vp = np.asarray(eng._kp), np.asarray(eng._vp)
+    assert kp[:, src[0]].any()          # the oracle is not all-zeros
+    for s, d in zip(src, fresh):
+        np.testing.assert_array_equal(kp[:, d], kp[:, s])
+        np.testing.assert_array_equal(vp[:, d], vp[:, s])
+    if kv_dtype is not None:
+        assert kp.dtype == np.int8
+        ks, vs = np.asarray(eng._ks), np.asarray(eng._vs)
+        for s, d in zip(src, fresh):
+            np.testing.assert_array_equal(ks[:, d], ks[:, s])
+            np.testing.assert_array_equal(vs[:, d], vs[:, s])
+    eng.page_pool.free(eng.page_pool.decref(fresh))
+    assert eng.audit_pages() == []
+
+
+# ---------------------------------------------------------------------------
+# radix hit on a spilled node: page-in, bit-identical to never-evicted
+# ---------------------------------------------------------------------------
+
+def _spill_workload(seed=11):
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(1, 97, size=24).tolist()
+    tails = [rng.integers(1, 97, size=6).tolist() for _ in range(2)]
+    churn = [rng.integers(1, 97, size=17).tolist() for _ in range(6)]
+    return shared, tails, churn
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_radix_hit_after_spill_bit_identical(kv_dtype):
+    net, _ = _tiny()
+    shared, tails, churn = _spill_workload()
+
+    def run(spill):
+        kw = dict(kv_dtype=kv_dtype)
+        if spill:
+            kw.update(prefix_cache_pages=4, host_kv_bytes=1 << 22)
+        else:
+            kw.update(prefix_cache_pages=64)
+        eng = _engine(net, **kw)
+        out = {}
+        r0 = Request(shared + tails[0], 6, request_id="r0", seed=7,
+                     **_SAMPLED)
+        eng.serve([r0])
+        out["r0"] = list(r0.output_tokens)
+        for i, p in enumerate(churn):
+            eng.serve([Request(p, 3, request_id=f"c{i}")])
+        r1 = Request(shared + tails[1], 6, request_id="r1", seed=9,
+                     **_SAMPLED)
+        eng.serve([r1])
+        out["r1"] = list(r1.output_tokens)
+        return out, eng
+
+    want, _ref = run(spill=False)
+    got, eng = run(spill=True)
+    assert got == want
+    s = eng.stats
+    assert s["kv_spill_pages"] >= 1
+    assert s["kv_pagein_pages"] >= 1
+    assert s["kv_spill_bytes"] > 0 and s["kv_pagein_bytes"] > 0
+    assert s["prefix_hits"] >= 1
+    assert eng.prefix_cache.paged_in_pages >= 1
+    assert eng.audit_pages() == []
+    assert eng.host_pool.audit() == []
+
+
+def test_evict_hook_and_tier_gauges_without_spill():
+    """Satellite: the eviction-callback seam and the resident/spilled
+    gauge pair exist (and stay coherent) with the spill tier OFF."""
+    net, _ = _tiny()
+    shared, _tails, churn = _spill_workload(seed=13)
+    eng = _engine(net, prefix_cache_pages=2)
+    assert eng.host_pool is None
+    calls = []
+
+    def hook(keypath, page):
+        calls.append((keypath, page))
+        return False                 # decline: plain discard
+
+    eng.prefix_cache.evict_hook = hook
+    eng.serve([Request(shared, 3, request_id="r0")])
+    for i, p in enumerate(churn[:3]):
+        eng.serve([Request(p, 3, request_id=f"c{i}")])
+    assert calls
+    assert all(isinstance(kp, tuple) and len(kp) >= 1
+               for kp, _pg in calls)
+    assert all(isinstance(pg, int) for _kp, pg in calls)
+    s = eng.stats
+    assert s["prefix_resident_pages"] == eng.prefix_cache.num_resident
+    assert s["prefix_spilled_pages"] == 0
+    assert s["kv_spill_pages"] == 0 and s["kv_pagein_pages"] == 0
+    assert eng.audit_pages() == []
+
+
+# ---------------------------------------------------------------------------
+# whole-request swap: preempt under overload, resume bit-identically
+# ---------------------------------------------------------------------------
+
+def _preempt_requests(seed=5):
+    rng = np.random.default_rng(seed)
+    plow = rng.integers(1, 97, size=12).tolist()
+    pa = rng.integers(1, 97, size=5).tolist()
+    pb = rng.integers(1, 97, size=5).tolist()
+    low = dict(prompt=plow, max_new=10, request_id="low", seed=3,
+               priority=2)
+    a = dict(prompt=pa, max_new=4, request_id="a", seed=4, priority=0)
+    b = dict(prompt=pb, max_new=4, request_id="b", seed=5, priority=0)
+    return low, a, b
+
+
+def _mk(spec):
+    spec = dict(spec)
+    return Request(spec.pop("prompt"), spec.pop("max_new"), **spec,
+                   **_SAMPLED)
+
+
+def _solo_reference(net, specs, kv_dtype):
+    """Fault-free oracle: each request served ALONE on a fresh engine
+    (outputs are keyed (seed, token_index) — scheduling-independent)."""
+    out = {}
+    for spec in specs:
+        r = _mk(spec)
+        _engine(net, kv_dtype=kv_dtype).serve([r])
+        out[r.id] = list(r.output_tokens)
+    return out
+
+
+def _run_preempt_schedule(net, kv_dtype, host_kv_bytes):
+    low_s, a_s, b_s = _preempt_requests()
+    pol = SheddingPolicy(queue_low=1, queue_high=2, preempt=True)
+    eng = _engine(net, num_slots=1, kv_dtype=kv_dtype,
+                  host_kv_bytes=host_kv_bytes, policy=pol,
+                  retry_backoff_s=0.0, clock=Tick())
+    low, a, b = _mk(low_s), _mk(a_s), _mk(b_s)
+    eng.submit(low)
+    steps = 0
+    while len(low.output_tokens) < 2:       # mid-decode, past prefill
+        eng.step()
+        steps += 1
+        assert steps < 50
+    eng.submit(a)
+    eng.submit(b)                           # queue >= high: OVERLOADED
+    eng.step()                              # preempts low for a
+    assert eng.stats["preempts"] == 1
+    assert low.status == "queued"
+    while eng.has_work:
+        eng.step()
+        steps += 1
+        assert steps < 200
+    assert all(r.status == "finished" for r in (low, a, b))
+    return {r.id: list(r.output_tokens) for r in (low, a, b)}, eng
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_preempt_resume_bit_identical(kv_dtype):
+    net, _ = _tiny()
+    low_s, a_s, b_s = _preempt_requests()
+    want = _solo_reference(net, (low_s, a_s, b_s), kv_dtype)
+    got, eng = _run_preempt_schedule(net, kv_dtype,
+                                     host_kv_bytes=1 << 22)
+    assert got == want
+    assert eng.stats["preempt_resumed"] == 1
+    assert eng.stats["preempt_restarted"] == 0
+    assert eng.stats["kv_pagein_pages"] >= 1    # the swapped pages
+    assert eng.audit_pages() == []
+    assert eng.host_pool.audit() == []
+    # swap payload consumed at resume: nothing lingers in the tier
+    assert all(k[0] != "req" for k in eng.host_pool.keys())
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_preempt_restart_fallback_bit_identical(kv_dtype):
+    """Host tier too small for the swap payload: the victim still
+    yields its slot, but restarts through the replay path — and the
+    output is STILL bit-identical (for int8, via the recorded
+    kv_history write schedule)."""
+    net, _ = _tiny()
+    low_s, a_s, b_s = _preempt_requests()
+    want = _solo_reference(net, (low_s, a_s, b_s), kv_dtype)
+    got, eng = _run_preempt_schedule(net, kv_dtype, host_kv_bytes=8)
+    assert got == want
+    assert eng.stats["preempt_restarted"] == 1
+    assert eng.stats["preempt_resumed"] == 0
+    assert eng.audit_pages() == []
+
+
+# ---------------------------------------------------------------------------
+# tensor parallelism: page-in lands in the head-sharded layout
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(len(jax.devices()) < 2,
+                    reason="needs >= 2 devices (CPU runs need "
+                    "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+def test_tp2_pagein_lands_head_sharded():
+    net, _ = _tiny()
+    shared, tails, churn = _spill_workload(seed=17)
+
+    def run(spill):
+        kw = dict(kv_dtype="int8", tp=2, tp_devices=jax.devices()[:2])
+        if spill:
+            kw.update(prefix_cache_pages=4, host_kv_bytes=1 << 22)
+        else:
+            kw.update(prefix_cache_pages=64)
+        eng = _engine(net, **kw)
+        out = {}
+        r0 = Request(shared + tails[0], 6, request_id="r0", seed=7,
+                     **_SAMPLED)
+        eng.serve([r0])
+        out["r0"] = list(r0.output_tokens)
+        for i, p in enumerate(churn):
+            eng.serve([Request(p, 3, request_id=f"c{i}")])
+        r1 = Request(shared + tails[1], 6, request_id="r1", seed=9,
+                     **_SAMPLED)
+        eng.serve([r1])
+        out["r1"] = list(r1.output_tokens)
+        return out, eng
+
+    want, _ref = run(spill=False)
+    eng = _engine(net, kv_dtype="int8", tp=2,
+                  tp_devices=jax.devices()[:2], prefix_cache_pages=4,
+                  host_kv_bytes=1 << 22)
+    sh_kp, sh_ks = eng._kp.sharding, eng._ks.sharding
+    got, eng2 = run(spill=True)
+    assert got == want
+    assert eng2.stats["kv_pagein_pages"] >= 1
+    # the donated tier scatter must hand the pools back in the SAME
+    # head-sharded layout the dispatch expects — a layout flip would
+    # be a steady-state recompile (and a silent 2x memory spike).
+    # Equivalence, not spec equality: JAX rebuilds the output sharding
+    # from the HLO sharding, which trims trailing replicated dims.
+    assert eng2._kp.sharding.is_equivalent_to(sh_kp, eng2._kp.ndim)
+    assert eng2._vp.sharding.is_equivalent_to(sh_kp, eng2._vp.ndim)
+    assert eng2._ks.sharding.is_equivalent_to(sh_ks, eng2._ks.ndim)
+    assert eng2.audit_pages() == []
+
+
+# ---------------------------------------------------------------------------
+# compile discipline: tier traffic is invisible to the dispatch
+# ---------------------------------------------------------------------------
+
+def test_spill_pagein_churn_compile_flat():
+    net, _ = _tiny()
+    shared, tails, churn = _spill_workload(seed=23)
+    eng = _engine(net, kv_dtype="int8", prefix_cache_pages=4,
+                  host_kv_bytes=1 << 22)
+    eng.serve([Request(shared + tails[0], 3, request_id="w0"),
+               Request([4, 4, 4], 3, request_id="w1", seed=0,
+                       **_SAMPLED)])
+    eng.mark_warm()
+    before = {fn.program: _cost.get(fn.program)["compiles"]
+              for fn in eng._programs.values()}
+    rng = np.random.default_rng(29)
+    for i, p in enumerate(churn):            # spill traffic
+        eng.serve([Request(p, 3, request_id=f"c{i}")])
+    for n in (5, 21, 27):                    # lengths never seen
+        eng.serve([Request(rng.integers(1, 97, size=n).tolist(), 3)])
+    eng.serve([Request(shared + tails[1], 3, request_id="hit",
+                       seed=1, **_SAMPLED)])  # page-in on spilled hit
+    after = {fn.program: _cost.get(fn.program)["compiles"]
+             for fn in eng._programs.values()}
+    assert after == before
+    assert eng.stats["kv_spill_pages"] >= 1
+    assert eng.stats["kv_pagein_pages"] >= 1
+    # the fixed-width index idiom: ONE cache entry per tier program,
+    # however many pages moved, plus the padded scale-zeroing scatter
+    assert eng._tier_gather_fn._cache_size() == 1
+    assert eng._tier_scatter_fn._cache_size() == 1
+    assert eng._zero_scales_fn._cache_size() == 1
+    assert eng.audit_pages() == []
+    assert eng.host_pool.audit() == []

@@ -78,7 +78,8 @@ def test_ownership_pass_spares_near_misses():
 def test_resource_pass_catches_seeded_violation():
     found = resources.run(_ctx("resources_bad.py"))
     assert _rules(found) == {"resource-release-on-error"}
-    assert [f.symbol for f in found] == ["Worker.grab"]
+    assert [f.symbol for f in found] == ["Worker.grab", "Worker.pagein"]
+    assert found[1].message.startswith("`.checkout()`")
 
 
 def test_resource_pass_spares_near_misses():

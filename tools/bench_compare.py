@@ -48,13 +48,14 @@ __all__ = ["load_records", "compare", "main"]
 _LOWER_BETTER = ("latency", "_ms", "seconds", "bytes", "loss",
                  "overhead", "ttft", "ttfb", "mismatch", "page_in",
                  "eviction", "compiles", "shed", "pending", "makespan",
-                 "stall", "disconnect")
+                 "stall", "disconnect", "reprefill")
 
 # capacity/throughput names where MORE is the win — checked FIRST so a
 # lower-is-better token sharing the name (e.g. `bytes` inside
 # `capacity_at_bytes.admitted_pages`) can't flip the direction
 _HIGHER_BETTER = ("goodput", "admitted_slots", "admitted_pages",
-                  "tokens_per_s", "throughput", "capacity", "per_chip")
+                  "tokens_per_s", "throughput", "capacity", "per_chip",
+                  "hit_rate")
 
 
 def lower_is_better(name):

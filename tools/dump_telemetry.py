@@ -17,6 +17,7 @@ Usage:
     python tools/dump_telemetry.py --tenants  # multi-tenant headline
     python tools/dump_telemetry.py --router   # multi-replica headline
     python tools/dump_telemetry.py --http     # HTTP-ingress headline
+    python tools/dump_telemetry.py --kv       # tiered-KV headline
 
 --trace writes the run's request timelines + spans as Chrome
 trace_event JSON (open in ui.perfetto.dev). --serve starts the live
@@ -256,6 +257,39 @@ def run_tenants():
     return eng
 
 
+def run_kv():
+    """A spill-pressured tiered-KV engine: a page budget several times
+    smaller than the working set plus a host-RAM tier, shared-prefix
+    traffic evicting and re-hitting spilled nodes — so the
+    serving_kv_spill*/serving_kv_pagein* instruments carry real values
+    in the dump."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import GPT2Config, GPT2ForCausalLM
+    from mxnet_tpu.serving import Request, ServingEngine
+
+    cfg = GPT2Config(vocab_size=97, units=32, num_layers=2, num_heads=2,
+                     max_length=64, dropout=0.0, attention_dropout=0.0)
+    net = GPT2ForCausalLM(cfg)
+    mx.rng.seed(0)
+    net.initialize(mx.init.Normal(0.05))
+    eng = ServingEngine(net, num_slots=2, max_length=64, page_size=8,
+                        attn_impl="xla", kv_dtype="int8",
+                        prefix_cache=True, prefix_cache_pages=4,
+                        host_kv_bytes=1 << 22)
+    rng = np.random.default_rng(0)
+    shared = rng.integers(1, cfg.vocab_size, 24).tolist()
+    eng.serve([Request(shared + rng.integers(1, 97, 4).tolist(), 4,
+                       request_id=700)])
+    for i in range(6):               # churn past the page budget
+        eng.serve([Request(rng.integers(1, 97, 17).tolist(), 3,
+                           request_id=701 + i)])
+    eng.serve([Request(shared + rng.integers(1, 97, 4).tolist(), 4,
+                       request_id=710)])   # radix hit pages back in
+    return eng
+
+
 def run_training():
     import numpy as np
 
@@ -300,6 +334,10 @@ def main():
                     help="also run a multi-tenant LoRA engine (paged "
                          "adapter slab + tenant quotas) and print the "
                          "per-tenant headline")
+    ap.add_argument("--kv", action="store_true",
+                    help="also run a spill-pressured tiered-KV engine "
+                         "(tiny page budget + host-RAM tier) and print "
+                         "the spill/page-in headline")
     ap.add_argument("--router", action="store_true",
                     help="also run a two-replica router with hedging "
                          "and a seeded mid-run replica kill and print "
@@ -326,6 +364,7 @@ def main():
     if args.spans:
         telemetry.enable_jsonl(args.spans)
     eng = spec = shed_eng = router = tenant_eng = frontend = None
+    kv_eng = None
     with telemetry.span("dump_telemetry.workloads"):
         if args.workload in ("serving", "both"):
             eng, spec = run_serving()
@@ -333,6 +372,8 @@ def main():
             shed_eng = run_shedding()
         if args.tenants:
             tenant_eng = run_tenants()
+        if args.kv:
+            kv_eng = run_kv()
         if args.router:
             router = run_router()
         if args.http:
@@ -398,6 +439,24 @@ def main():
               f"{pool.num_registered}, page-ins {pool.page_ins} "
               f"({page_rate:.2f}/prefill), evictions {pool.evictions}, "
               f"slab {pool.slab_bytes() / 1024:.1f} KiB")
+    if kv_eng is not None:
+        # the tiered-KV headline: how much re-prefill the host tier is
+        # absorbing, and both tiers' occupancy right now
+        s = kv_eng.stats
+        hp = kv_eng.host_pool
+        lookups = s["prefix_hits"] + s["prefix_misses"]
+        rate = s["prefix_hits"] / lookups if lookups else 0.0
+        print(f"# kv-tier: spilled {s['kv_spill_pages']} pages "
+              f"({s['kv_spill_bytes'] / 1024:.1f} KiB), paged in "
+              f"{s['kv_pagein_pages']} ({s['kv_pagein_bytes'] / 1024:.1f}"
+              f" KiB), host {hp.num_entries} entries "
+              f"{hp.bytes_used / 1024:.1f}/{hp.budget_bytes / 1024:.1f} "
+              f"KiB (evictions {s['kv_host_evictions']}), resident "
+              f"{s['prefix_resident_pages']} / spilled "
+              f"{s['prefix_spilled_pages']} tree pages, hit-rate "
+              f"{rate:.2%}, preempts {s['preempts']} "
+              f"(resumed {s['preempt_resumed']}, restarted "
+              f"{s['preempt_restarted']})")
     if router is not None:
         # the multi-replica headline: placement quality, failover and
         # hedging outcomes, and where each replica stands right now

@@ -36,6 +36,8 @@ Usage:
     JAX_PLATFORMS=cpu python tools/http_soak.py
     python tools/http_soak.py --requests 96 --seed 3 --kill-after 8
     python tools/http_soak.py --replicas 3 --rate 40 --kill-after 0
+    python tools/http_soak.py --hbm-budget-bytes 163840 \
+        --host-budget-bytes 4194304   # tiered KV: spill + page-in
 """
 import argparse
 import http.client
@@ -174,6 +176,22 @@ def main(argv=None):
                          "fleet matches an unsharded engine (on a CPU "
                          "host the virtual device count is forced "
                          "automatically)")
+    ap.add_argument("--hbm-budget-bytes", type=int, default=None,
+                    metavar="N",
+                    help="byte-denominated KV page budget per replica "
+                         "(PagePool.from_bytes sizing) — set it below "
+                         "the working set so the prefix cache evicts "
+                         "under the soak")
+    ap.add_argument("--host-budget-bytes", type=int, default=None,
+                    metavar="M",
+                    help="host-RAM KV spill tier per replica (implies "
+                         "prefix_cache): evicted pages spill instead "
+                         "of vanishing and page back in on radix hits. "
+                         "The offline reference stays spill-OFF, so "
+                         "the bit-identity bar is exactly the tier's "
+                         "exactness contract — 0 output mismatches vs "
+                         "the spill-off reference, no page leaked "
+                         "across tiers (cross-tier audit)")
     ap.add_argument("--json", default=None,
                     help="also write the summary JSON to this path")
     args = ap.parse_args(argv)
@@ -223,11 +241,24 @@ def main(argv=None):
                          else "hangup" if u < 0.8 else "slow")
 
     # the request set: greedy, so every replica/batching/migration
-    # history must produce the SAME tokens as the offline reference
+    # history must produce the SAME tokens as the offline reference.
+    # Tiered runs draw prompts from shared multi-page prefix families
+    # whose combined working set overflows the retention budget — the
+    # soak then actually spills, pages in on radix revisits, and the
+    # bit-identity bar covers the tier (random sub-page prompts never
+    # would).
+    tiered = args.host_budget_bytes is not None
+    fams = [rng.integers(1, cfg.vocab_size, 3 * page).tolist()
+            for _ in range(6)] if tiered else None
     bodies, prompts = [], []
     for i in range(args.requests):
-        prompt = rng.integers(1, cfg.vocab_size,
-                              int(rng.integers(3, 13))).tolist()
+        if tiered:
+            prompt = (fams[int(rng.integers(0, len(fams)))]
+                      + rng.integers(1, cfg.vocab_size,
+                                     int(rng.integers(0, 6))).tolist())
+        else:
+            prompt = rng.integers(1, cfg.vocab_size,
+                                  int(rng.integers(3, 13))).tolist()
         prompts.append(prompt)
         body = {"prompt": prompt,
                 "max_new_tokens": int(rng.integers(6, 17)),
@@ -236,7 +267,7 @@ def main(argv=None):
             body["stream_buffer"] = 2       # < decode_block
         bodies.append(body)
 
-    def new_engine(max_queue=None, tp=1):
+    def new_engine(max_queue=None, tp=1, spill=False):
         kv = None if args.kv_dtype == "float32" else args.kv_dtype
         # int8 pages: the chunk grid is part of the numerics, so the
         # bit-identity bar needs a non-binding prefill budget — every
@@ -244,16 +275,26 @@ def main(argv=None):
         # the replicas, and a migration replay (docs/SERVING.md
         # "Quantized KV pages")
         budget = slots * page if kv else None
+        # the tiered replicas and the spill-off reference both run a
+        # prefix cache, so the ONLY thing the bit-identity bar varies
+        # is the host tier itself (docs/SERVING.md "Tiered KV cache")
         eng = ServingEngine(net, num_slots=slots, max_length=max_len,
                             page_size=page, decode_block=block,
                             attn_impl="xla", max_queue=max_queue,
                             kv_dtype=kv, prefill_chunk_budget=budget,
+                            prefix_cache=tiered,
+                            hbm_budget_bytes=(args.hbm_budget_bytes
+                                              if spill else None),
+                            host_kv_bytes=(args.host_budget_bytes
+                                           if spill else None),
                             tp=tp)
         # warm every prefill bucket a migrated request can land in
-        # (re-prefill covers prompt + already-emitted tokens)
+        # (re-prefill covers prompt + already-emitted tokens; tiered
+        # prompts are longer — 3 shared pages + a 0-5 token tail)
+        pmax = (3 * page + 5) if tiered else 12
         eng.serve([Request(list(range(1, b + 1)), 2,
                            request_id=f"warm{b}")
-                   for b in range(page, min(12 + 16 + page, max_len),
+                   for b in range(page, min(pmax + 16 + page, max_len),
                                   page)])
         eng.mark_warm()
         eng.reset_stats()
@@ -269,7 +310,7 @@ def main(argv=None):
                  for r in ref_reqs}
     assert all(r.status == "finished" for r in ref_reqs)
 
-    engines = [new_engine(max_queue=4, tp=args.tp)
+    engines = [new_engine(max_queue=4, tp=args.tp, spill=tiered)
                for _ in range(args.replicas)]
     compiles_at_warm = {e._eid: _compiles(e._eid) for e in engines}
     router = ServingRouter(engines, hedge_after_s=1e9)
@@ -368,6 +409,13 @@ def main(argv=None):
                   f"engine{e._eid} page audit: {e.audit_pages()}")
             check(e.audit_adapters() == [],
                   f"engine{e._eid} adapter audit: {e.audit_adapters()}")
+            if e.host_pool is not None:
+                # cross-tier leak bar: nothing pinned, no orphaned or
+                # double-resident page between HBM and the host tier
+                # (audit_pages above already checks residency overlap)
+                check(e.host_pool.audit() == [],
+                      f"engine{e._eid} host tier audit: "
+                      f"{e.host_pool.audit()}")
             drift = _compiles(e._eid) - compiles_at_warm[e._eid]
             check(drift == 0,
                   f"engine{e._eid} steady_state_compiles = {drift}")
@@ -466,6 +514,17 @@ def main(argv=None):
         "steady_state_compiles": {
             f"engine{e._eid}": _compiles(e._eid) - compiles_at_warm[e._eid]
             for e in engines},
+        "kv_tier": None if not tiered else {
+            "kv_spill_pages": sum(e.stats["kv_spill_pages"]
+                                  for e in engines),
+            "kv_pagein_pages": sum(e.stats["kv_pagein_pages"]
+                                   for e in engines),
+            "kv_host_evictions": sum(e.stats["kv_host_evictions"]
+                                     for e in engines),
+            "kv_host_entries_left": sum(e.host_pool.num_entries
+                                        for e in engines),
+            "preempts": sum(e.stats["preempts"] for e in engines),
+        },
         "failures": failures,
         "ok": not failures,
     }
