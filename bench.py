@@ -1953,12 +1953,19 @@ def bench_gpt2_serving_kvspill():
     the evicted pages and re-prefills every revisit from scratch;
     spill ON moves them to a host-RAM tier and pages them back in on
     the radix hit — same fixed-shape dispatch, tier traffic outside
-    the traced graph. Pass criteria: spill-on goodput >= 1.3x
-    spill-off, STRICTLY fewer prefilled tokens, 0 greedy output
+    the traced graph. The round also decomposes TTFT p99 into the
+    phase budget (telemetry.PHASES) per KV tier (resident/spilled/
+    cold) under the tiered load, and gates the OBSERVABILITY cost
+    itself: a rotated-order A/B (3 runs per arm, best-of basis) of
+    the same spill-on stream with request tracing + SLO accounting
+    disabled vs enabled must show < 2% goodput overhead. Pass criteria: spill-on goodput >=
+    1.3x spill-off, STRICTLY fewer prefilled tokens, 0 greedy output
     mismatches vs the spill-off engine (the tier's exactness
     contract), zero steady-state compiles on BOTH engines, clean page
-    + host-tier audits, everything finished. vs_baseline is the
-    on/off goodput ratio (>1 = page-in beat re-prefill)."""
+    + host-tier audits, everything finished, a spilled-tier phase
+    breakdown with real host_pagein time, obs overhead < 2%.
+    vs_baseline is the on/off goodput ratio (>1 = page-in beat
+    re-prefill)."""
     import jax
     import mxnet_tpu as mx
     from mxnet_tpu.models import GPT2ForCausalLM, gpt2_774m_config
@@ -2087,6 +2094,76 @@ def bench_gpt2_serving_kvspill():
     out_off, out_on = off.pop("outputs"), on.pop("outputs")
     mismatches = sum(int(out_off[k] != out_on[k]) for k in out_off)
 
+    # -- observability-overhead A/B (rotated order, best-of basis) -------
+    # same spill-on stream, tracing + SLO accounting out of / in the
+    # request path. Rotation cancels linear machine drift; the
+    # BEST-OF-3 goodput per arm is the estimator (timeit-style
+    # min-time: scheduler jitter and GC pauses only ever slow a run
+    # down, so per-run goodput is one-sided noise that a mean would
+    # launder into the gate)
+    from mxnet_tpu import telemetry
+
+    def obs_arm(instrumented, tag):
+        telemetry.request_log.enabled = instrumented
+        if instrumented:
+            telemetry.slo.configure([
+                telemetry.SLO("bench_ttft", ttft_p99_ms=60_000.0),
+                telemetry.SLO("bench_goodput", goodput_min=1.0)])
+        try:
+            r = run_config(tag, host_budget)
+        finally:
+            telemetry.request_log.enabled = True
+            telemetry.slo.slo_engine.configure(())
+        r.pop("outputs")
+        return r["goodput_tokens_per_sec"]
+
+    order = (False, True, True, False, False, True)
+    arm_goodput = [obs_arm(en, f"obs{i}")
+                   for i, en in enumerate(order)]
+    g_plain = max(g for en, g in zip(order, arm_goodput) if not en)
+    g_traced = max(g for en, g in zip(order, arm_goodput) if en)
+    obs_overhead = round(float(g_plain) / max(float(g_traced), 1e-9)
+                         - 1.0, 4)
+
+    # -- TTFT phase budget per KV tier, from the traced arms -------------
+    def phase_breakdown(tags):
+        rows = {}
+        for tr in telemetry.request_log.recent(10**6):
+            rid = str(tr["request_id"])
+            if not any(rid.startswith(t + "-v") for t in tags):
+                continue
+            ft = [e for e in tr["events"] if e["event"] == "first_token"]
+            if not ft:
+                continue
+            rows.setdefault(ft[-1].get("kv_tier", "cold"), []).append(
+                (float(ft[-1]["ttft"]), tr.get("phases") or {}))
+        out = {}
+        for tier, samples in sorted(rows.items()):
+            ttfts = [t for t, _ in samples]
+            tot = {}
+            for _, ph in samples:
+                for k, v in ph.items():
+                    tot[k] = tot.get(k, 0.0) + v
+            grand = sum(tot.values()) or 1.0
+            out[tier] = {
+                "requests": len(samples),
+                "ttft_p50_ms": round(
+                    float(np.percentile(ttfts, 50)) * 1e3, 2),
+                "ttft_p99_ms": round(
+                    float(np.percentile(ttfts, 99)) * 1e3, 2),
+                "phase_p99_ms": {
+                    k: round(float(np.percentile(
+                        [ph.get(k, 0.0) for _, ph in samples], 99))
+                        * 1e3, 2) for k in sorted(tot)},
+                "phase_share": {k: round(tot[k] / grand, 4)
+                                for k in sorted(tot)},
+            }
+        return out
+
+    breakdown = phase_breakdown(
+        [f"obs{i}" for i, en in enumerate(order) if en])
+    spilled = breakdown.get("spilled", {})
+
     goodput_ratio = round(on["goodput_tokens_per_sec"]
                           / max(off["goodput_tokens_per_sec"], 1e-9), 3)
     prefill_ratio = round(off["prefill_tokens"]
@@ -2101,6 +2178,10 @@ def bench_gpt2_serving_kvspill():
         "prefix_families": families, "visits": visits,
         "prefix_len": prefix_len,
         "greedy_mismatches": mismatches,
+        "ttft_phase_breakdown": breakdown,
+        "obs_overhead": obs_overhead,
+        "obs_goodput_traced": round(float(g_traced), 2),
+        "obs_goodput_plain": round(float(g_plain), 2),
         "on": on, "off": off,
         "slots": slots,
         "arrivals": "open-loop" if rate == 0 else f"poisson({rate}/s)",
@@ -2123,6 +2204,14 @@ def bench_gpt2_serving_kvspill():
     _emit("gpt2_serving_kvspill_reprefill_tokens", on["prefill_tokens"],
           "tokens", prefill_ratio,
           extras={"off_prefill_tokens": off["prefill_tokens"]})
+    # gate lane: tracing + SLO accounting must stay out of the serving
+    # hot path — additive vs_baseline against the 2% budget
+    _emit("gpt2_serving_kvspill_obs_overhead", obs_overhead, "fraction",
+          round(1.0 + obs_overhead, 4),
+          extras={"budget": 0.02,
+                  "goodput_traced": round(float(g_traced), 2),
+                  "goodput_plain": round(float(g_plain), 2),
+                  "order": "rotated x3 per arm, best-of basis"})
     ok = (working_set_pages >= 3 * budget_pages
           and goodput_ratio >= 1.3
           and on["prefill_tokens"] < off["prefill_tokens"]
@@ -2133,7 +2222,10 @@ def bench_gpt2_serving_kvspill():
           and off["steady_state_compiles"] == 0
           and not on["audit_leaks"] and not off["audit_leaks"]
           and on["finished"] == on["requests"]
-          and off["finished"] == off["requests"])
+          and off["finished"] == off["requests"]
+          and obs_overhead < 0.02
+          and spilled.get("requests", 0) > 0
+          and spilled.get("phase_share", {}).get("host_pagein", 0) > 0)
     return 0 if ok else 1
 
 

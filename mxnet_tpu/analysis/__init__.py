@@ -1,11 +1,14 @@
 """graftlint: AST-based invariant checker for the mxnet_tpu repo.
 
-Four whole-program passes (stdlib `ast` only — no jax import needed):
+Five whole-program passes (stdlib `ast` only — no jax import needed):
 
   * trace-safety     — no host-sync escapes inside jit-traced code
   * thread-ownership — handler threads never reach @loop_only state
   * resource         — every lease released on exception edges
   * catalog          — metric names literal + documented
+  * phases           — TTFT phase-name literals drawn from
+                       telemetry.PHASES (the budget only sums when
+                       producers share one taxonomy)
 
 plus the runtime annotation vocabulary (@loop_only / @thread_safe /
 @supervised and the MX_ASSERT_OWNERSHIP=1 assertion machinery) that

@@ -182,6 +182,7 @@ def register_everything():
     telemetry.memory._gauges(telemetry.default_registry)
     telemetry.cost._metrics()                  # cost/compile family
     telemetry.ledger._gauges(telemetry.default_registry)
+    telemetry.slo.slo_engine._families()       # slo burn/event family
     with telemetry.span("catalog_check"):      # span_duration_seconds
         pass
     telemetry.flight.install(out_dir="/tmp/mx-catalog-check")

@@ -159,10 +159,10 @@ def run_passes(ctx, passes=None):
     """Run the static passes over a Context; findings sorted by
     (path, line). Unparsable files surface as `parse-error` findings
     so a syntax error can never silently shrink coverage."""
-    from . import catalog, ownership, resources, trace_safety
+    from . import catalog, ownership, phases, resources, trace_safety
     if passes is None:
         passes = (trace_safety.run, ownership.run, resources.run,
-                  catalog.run)
+                  catalog.run, phases.run)
     findings = [Finding("parse-error", path, 1, "<module>", msg)
                 for path, msg in ctx.errors]
     for p in passes:

@@ -1,0 +1,112 @@
+"""Phases pass: TTFT phase-name literals must come from the taxonomy.
+
+The phase-budget decomposition (docs/OBSERVABILITY.md "TTFT phase
+taxonomy") only works if every producer and consumer agrees on the
+five phase names in `telemetry.request_trace.PHASES`. The runtime
+guards the boundary — `RequestTraceLog.phase()` raises on an unknown
+name — but only for code paths a test actually drives; a typo in a
+rarely-taken branch (a new engine path, a tool rendering the
+waterfall) would ship silently. This pass closes that statically:
+every STRING LITERAL passed as the phase name to a call whose target
+is `phase(...)` or `_phase(...)` must be a member of the PHASES tuple,
+which is read from request_trace.py's own AST so the lint can never
+drift from the runtime enum (and never needs to import jax).
+
+Names that arrive through variables pass silently — the runtime check
+owns those — so the pass has no false positives on forwarding helpers
+like `ServingEngine._phase`, which pipes its `name` argument through.
+
+Rule: phase-unknown-name.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import Finding, terminal_name
+
+__all__ = ["run", "phase_enum"]
+
+RULE = "phase-unknown-name"
+
+_ENUM_PATH = os.path.join("mxnet_tpu", "telemetry", "request_trace.py")
+
+# call-target terminal name -> (positional index of the phase arg,
+# keyword name of the phase arg). RequestTraceLog.phase(request_id,
+# engine, phase, dur) and ServingEngine._phase(req, name, dur) — the
+# bound-method positional layouts as call sites actually write them.
+_SIGNATURES = {"phase": (2, "phase"), "_phase": (1, "name")}
+
+
+def phase_enum(ctx):
+    """The PHASES tuple parsed out of request_trace.py's AST, or None
+    when the module (or the assignment) is absent from the context."""
+    tree = ctx.trees.get(_ENUM_PATH)
+    if tree is None:
+        return None
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        t = node.targets[0]
+        if isinstance(t, ast.Name) and t.id == "PHASES" \
+                and isinstance(node.value, ast.Tuple):
+            vals = []
+            for elt in node.value.elts:
+                if not (isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, str)):
+                    return None
+                vals.append(elt.value)
+            return tuple(vals)
+    return None
+
+
+def _phase_literal(call, which):
+    """The str-literal phase argument of one call, or None when it is
+    not a literal (variables are the runtime check's job)."""
+    pos, kw_name = _SIGNATURES[which]
+    node = call.args[pos] if len(call.args) > pos else None
+    for kw in call.keywords:
+        if kw.arg == kw_name:
+            node = kw.value
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def run(ctx):
+    enum = phase_enum(ctx)
+    if enum is None:
+        # No taxonomy in view (partial lint of unrelated paths):
+        # nothing to check literals against.
+        return []
+    allowed = set(enum)
+    findings = []
+    for path, tree in ctx.trees.items():
+        if path == _ENUM_PATH:
+            continue                  # the enum's own module defines it
+        stack = []
+
+        def visit(node):
+            pushed = isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef, ast.ClassDef))
+            if pushed:
+                stack.append(node.name)
+            if isinstance(node, ast.Call):
+                which = terminal_name(node.func)
+                if which in _SIGNATURES:
+                    lit = _phase_literal(node, which)
+                    if lit is not None and lit not in allowed:
+                        findings.append(Finding(
+                            RULE, path, node.lineno,
+                            ".".join(stack) or "<module>",
+                            f"phase name {lit!r} is not in "
+                            f"telemetry.PHASES {enum} — the phase "
+                            f"budget only sums when every producer "
+                            f"uses the shared taxonomy"))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if pushed:
+                stack.pop()
+
+        visit(tree)
+    return findings

@@ -338,19 +338,37 @@ def _engine_metrics(eid):
     _shed_family()                  # registered per-process; children
     _tenant_families()
     _ttft_family()
+    _ttft_phase_family()
     return {k: inst.labels(eid) for k, inst in m.items()}
 
 
 def _ttft_family():
-    """TTFT split by power-of-two prompt-length bucket: the chunked-
-    prefill TTFT model (docs/SERVING.md) predicts TTFT grows with
-    ceil(prompt / chunk_tokens) dispatch periods — this histogram is
-    how that claim is checked in production."""
+    """TTFT split by power-of-two prompt-length bucket AND the KV tier
+    the admission landed on: the chunked-prefill TTFT model
+    (docs/SERVING.md) predicts TTFT grows with ceil(prompt /
+    chunk_tokens) dispatch periods, and the tier label is how
+    p99-under-tiered-load is attributed — a host page-in admission
+    (`spilled`) pays transfer latency a `resident` radix hit never
+    sees."""
     return telemetry.histogram(
         "serving_ttft_by_prompt_seconds",
         "submit -> first token, split by power-of-two prompt-length "
-        "bucket (label prompt_bucket=le<N>)",
-        ("engine", "prompt_bucket"))
+        "bucket (label prompt_bucket=le<N>) and KV tier "
+        "(kv_tier=resident|spilled|cold)",
+        ("engine", "prompt_bucket", "kv_tier"))
+
+
+def _ttft_phase_family():
+    """The TTFT phase budget, aggregated: every first token observes
+    one sample per recorded phase (queue_wait, prefix_match,
+    host_pagein, prefill_chunks, first_decode — telemetry.PHASES),
+    labeled with the admission's KV tier, so p99 TTFT decomposes into
+    WHERE the time went without reading per-request timelines."""
+    return telemetry.histogram(
+        "serving_ttft_phase_seconds",
+        "per-request TTFT phase durations (label phase=one of "
+        "telemetry.PHASES, kv_tier=resident|spilled|cold)",
+        ("engine", "phase", "kv_tier"))
 
 
 def _shed_family():
@@ -794,7 +812,17 @@ class ServingEngine:
         self._shed_children = {}   # (reason, priority) -> labeled child
         self._shed_counts = {}     # same keys, host-side for stats
         self._ttft_fam = _ttft_family()
-        self._ttft_children = {}   # prompt bucket -> labeled child
+        self._ttft_children = {}   # (prompt bucket, tier) -> child
+        self._phase_fam = _ttft_phase_family()
+        self._phase_children = {}  # (phase, tier) -> labeled child
+        # TTFT phase-budget bookkeeping (docs/OBSERVABILITY.md "Phase
+        # taxonomy"): per-admission host page-in accumulator (the
+        # prefix-cache pagein hook fires inside _map_slot_pages, so
+        # per-request attribution needs this bracket), the KV tier the
+        # admission landed on, and the prefill chunks fed per slot
+        self._pagein_acc = 0.0
+        self._kv_tier = ["cold"] * B
+        self._chunks_fed = np.zeros(B, np.int32)
         self._tenant_fams = _tenant_families()
         self._tenant_children = {}   # (family, tenant[, reason]) -> child
         self._tenant_shed_counts = {}  # (tenant, reason) -> n
@@ -966,18 +994,49 @@ class ServingEngine:
         self._tenants_seen.add(tenant)
         return child
 
-    def _observe_ttft(self, prompt_len, dt):
+    def _observe_ttft(self, prompt_len, dt, kv_tier="cold"):
         """The labeled TTFT-vs-prompt-length child (power-of-two
-        buckets; children created lazily as lengths appear)."""
+        buckets x KV tier; children created lazily as combinations
+        appear in traffic)."""
         b = 1
         while b < prompt_len:
             b <<= 1
-        key = f"le{b}"
+        key = (f"le{b}", kv_tier)
         child = self._ttft_children.get(key)
         if child is None:
-            child = self._ttft_fam.labels(self._eid, key)
+            child = self._ttft_fam.labels(self._eid, key[0], kv_tier)
             self._ttft_children[key] = child
         child.observe(dt)
+
+    def _phase(self, req, name, dur, **attrs):
+        """Record one TTFT phase span: trace event + per-request
+        accumulation (`req.phases` — it rides the Request through
+        export/adopt, which is what keeps a migrated request's phase
+        budget continuous). Disabled with the request log for honest
+        A/B overhead runs."""
+        if not telemetry.request_log.enabled:
+            return
+        dur = max(float(dur), 0.0)
+        ph = getattr(req, "phases", None)
+        if not isinstance(ph, dict):
+            ph = req.phases = {}
+        ph[name] = ph.get(name, 0.0) + dur
+        telemetry.request_log.phase(req.id, self._eid, name, dur,
+                                    **attrs)
+
+    def _observe_phase_budget(self, req, kv_tier):
+        """Publish the request's accumulated phase budget into the
+        phase histogram at first token (one sample per phase)."""
+        ph = getattr(req, "phases", None)
+        if not isinstance(ph, dict):
+            return
+        for name, dur in ph.items():
+            key = (name, kv_tier)
+            child = self._phase_children.get(key)
+            if child is None:
+                child = self._phase_fam.labels(self._eid, name, kv_tier)
+                self._phase_children[key] = child
+            child.observe(dur)
 
     def _set_load_gauges(self):
         self._metrics["queue_depth"].set(self.scheduler.num_queued)
@@ -1403,11 +1462,21 @@ class ServingEngine:
                          "tenant_quota" if isinstance(e, TenantQuotaError)
                          else "queue_full", cause=e)
         request.status = "queued"
-        telemetry.request_log.begin(
-            request.id, self._eid, prompt_len=request.prompt_len,
+        request.phases = {}
+        request.t_enqueue = now
+        t = getattr(request, "trace", None) or {}
+        tr = telemetry.request_log.begin(
+            request.id, self._eid, trace_id=t.get("trace_id"),
+            prompt_len=request.prompt_len,
             max_new_tokens=request.max_new_tokens,
             priority=request.priority,
-            deadline_ms=request.deadline_ms)
+            deadline_ms=request.deadline_ms,
+            parent_span=t.get("parent_span"))
+        if tr is not None and not t:
+            # no upstream trace context (direct engine submit): the
+            # trace id minted here still rides the Request so a later
+            # migration/hedge correlates to ONE trace
+            request.trace = {"trace_id": tr.trace_id}
         self._metrics["queue_depth"].set(self.scheduler.num_queued)
         return out
 
@@ -1473,8 +1542,19 @@ class ServingEngine:
         request.t_not_before = 0.0
         self.scheduler.requeue(request)
         request.status = "queued"
+        request.t_enqueue = now
+        if not isinstance(getattr(request, "phases", None), dict):
+            request.phases = {}
+        # stitch: export_requests packed the origin timeline's trace id
+        # and start onto the Request — the continuation opens with the
+        # SAME trace id, the ORIGINAL t_begin, and the phase budget
+        # accumulated so far, so the migrated request reads as one
+        # trace, not two orphans
+        t = getattr(request, "trace", None) or {}
         telemetry.request_log.begin(
-            request.id, self._eid, prompt_len=request.prompt_len,
+            request.id, self._eid, trace_id=t.get("trace_id"),
+            t_begin=t.get("t_begin"), phases=request.phases,
+            prompt_len=request.prompt_len,
             max_new_tokens=request.max_new_tokens,
             priority=request.priority,
             deadline_ms=request.deadline_ms,
@@ -1516,6 +1596,15 @@ class ServingEngine:
             # it; the adopter restarts via the replay path instead
             self._drop_swap(req)
             req.status = "exported"
+            # pack the stitch context BEFORE ending the timeline: the
+            # adopting replica re-opens the trace with the same id and
+            # original start (adopt() passes these back to begin())
+            tr = telemetry.request_log.live_trace(req.id, self._eid)
+            if tr is not None:
+                t = dict(getattr(req, "trace", None) or {})
+                t.setdefault("trace_id", tr.trace_id)
+                t["t_begin"] = tr.t_begin
+                req.trace = t
             telemetry.request_log.end(
                 req.id, self._eid, "migrated",
                 tokens=len(req.output_tokens))
@@ -1834,7 +1923,8 @@ class ServingEngine:
         else:
             backoff = self.retry_backoff_s
         req.t_not_before = now + backoff
-        self._metrics["dispatch_retries"].inc()
+        req.t_enqueue = now     # queue_wait re-counts from HERE, not
+        self._metrics["dispatch_retries"].inc()   # from t_submit
         self.scheduler.requeue(req)
         req.status = "queued"
         telemetry.request_log.event(
@@ -2188,10 +2278,15 @@ class ServingEngine:
         finally:
             for key in taken:
                 self.host_pool.release(key, drop=ok)
+        dt = self._clock() - t0
         m = self._metrics
         m["kv_pagein_pages"].inc(len(items))
         m["kv_pagein_bytes"].inc(nbytes)
-        m["kv_pagein_seconds"].observe(self._clock() - t0)
+        m["kv_pagein_seconds"].observe(dt)
+        # per-request attribution: _admit zeroes this bracket before
+        # the page map, so whatever the match paged in lands in the
+        # admitting request's host_pagein phase
+        self._pagein_acc += dt
 
     def _host_evict(self, key):
         """HostPagePool evict_cb: the tier wants to LRU-drop `key` to
@@ -2273,6 +2368,7 @@ class ServingEngine:
                 self.host_pool.discard(key)
             m["preempt_restarted"].inc()
         self._release_slot(slot)
+        req.t_enqueue = self._clock()
         self.scheduler.requeue(req)
         req.status = "queued"
         telemetry.request_log.event(
@@ -2440,8 +2536,12 @@ class ServingEngine:
         # pages: length starts at the cached offset and the queue holds
         # only the uncached tail (>= 1 token — a fully cached prompt is
         # re-homed by the CoW split to recompute its last position)
+        t_map0 = self._clock()
+        self._pagein_acc = 0.0
         offset = self._map_slot_pages(slot, tokens,
                                       match=not (self._quant and base))
+        t_map1 = self._clock()
+        pagein_s = self._pagein_acc
         req.status = "prefilling"
         if req.tenant is not None:
             self._tenant_child("admitted", req.tenant).inc()
@@ -2455,10 +2555,32 @@ class ServingEngine:
                 m["prefix_tokens_saved"].inc(offset)
             else:
                 m["prefix_misses"].inc()
+        # KV tier of THIS admission: a page-in during the match means
+        # the prefix came back from the host tier; a hit without one
+        # was device-resident; no cached prefix is a cold start
+        self._kv_tier[slot] = "spilled" if pagein_s > 0.0 \
+            else ("resident" if offset else "cold")
+        self._chunks_fed[slot] = 0
         if not base:
             # latency SLO metrics describe the FIRST admission only —
             # a restart's wait is retry bookkeeping, not user TTFT
             m["admission_wait"].observe(self._clock() - req.t_submit)
+            # TTFT phase budget: queue_wait ends where the page map
+            # begins; the map splits into prefix_match (radix walk +
+            # CoW/alloc) and host_pagein (tier transfers the match
+            # triggered). The remaining TTFT share lands at the first
+            # token (_dispatch): prefill_chunks up to the final
+            # chunk's dispatch, first_decode for that dispatch itself.
+            t_enq = getattr(req, "t_enqueue", None)
+            self._phase(req, "queue_wait",
+                        t_map0 - (t_enq if t_enq is not None
+                                  else req.t_submit))
+            self._phase(req, "prefix_match",
+                        (t_map1 - t_map0) - pagein_s,
+                        cached_tokens=int(offset))
+            if pagein_s > 0.0:
+                self._phase(req, "host_pagein", pagein_s)
+            req.t_mark = t_map1
         # budget: every decode step writes one KV; the last sampled
         # token is never written, so a sequence of Tp supports up to
         # max_length - Tp + 1 further generated tokens; `base` already
@@ -2933,6 +3055,7 @@ class ServingEngine:
             cl = int(chunk_len[slot])
             if cl:
                 self._pending[slot] = self._pending[slot][cl:]
+                self._chunks_fed[slot] += 1
                 if rl.enabled:
                     rl.event(req.id, self._eid, "prefill_chunk",
                              dur=dt, tokens=cl,
@@ -2959,8 +3082,26 @@ class ServingEngine:
                 if not self._base[slot]:
                     req.t_admit = now
                     ttft = now - req.t_submit
+                    tier = self._kv_tier[slot]
                     m["ttft"].observe(ttft)
-                    self._observe_ttft(req.prompt_len, ttft)
+                    self._observe_ttft(req.prompt_len, ttft, tier)
+                    # close the TTFT phase budget: everything between
+                    # the admit mark and this dispatch's start is
+                    # prefill_chunks (earlier chunk dispatches + the
+                    # waits between them); the dispatch that sampled
+                    # the first token is first_decode. With the marks
+                    # on ONE clock the five phases sum to TTFT exactly
+                    # (minus re-queue gaps on restart/migration paths).
+                    t_mark = getattr(req, "t_mark", None)
+                    if rl.enabled and t_mark is not None:
+                        self._phase(req, "prefill_chunks", t0 - t_mark,
+                                    chunks=int(self._chunks_fed[slot]))
+                        self._phase(req, "first_decode", dt)
+                        rl.event(req.id, self._eid, "first_token",
+                                 ttft=ttft, kv_tier=tier)
+                    self._observe_phase_budget(req, tier)
+                    telemetry.slo.observe_ttft(
+                        ttft, priority=req.priority, tenant=req.tenant)
                 pc = self.prefix_cache
                 if pc is not None:
                     # adopt the PROMPT's full pages into the radix
@@ -3124,6 +3265,14 @@ class ServingEngine:
         req.status = "finished"
         self._finish_times.append(self._clock())   # drain-rate window
         self._metrics["requests_finished"].inc()
+        if req.t_admit is not None and req.t_finish > req.t_admit \
+                and len(req.output_tokens) > 1:
+            # per-request decode goodput (tokens/s from first token to
+            # finish) — the goodput_min SLO's observation stream
+            telemetry.slo.observe_goodput(
+                (len(req.output_tokens) - 1)
+                / (req.t_finish - req.t_admit),
+                priority=req.priority, tenant=req.tenant)
         telemetry.request_log.end(
             req.id, self._eid, "finished", reason=reason,
             tokens=len(req.output_tokens))

@@ -254,6 +254,15 @@ class _Handler(BaseHTTPRequestHandler):
         except (MXNetError, TypeError, ValueError, KeyError) as e:
             self._counted_reply(_invalid_body(e), 400)
             return
+        # W3C trace context: adopt the caller's trace id (invalid
+        # headers are ignored per spec, never 400), else mint one —
+        # the id rides the Request through router/engine/migration
+        # and comes back on the response's own traceparent header
+        tp = telemetry.parse_traceparent(self.headers.get("traceparent"))
+        if tp is not None:
+            req.trace = {"trace_id": tp[0], "parent_span": tp[1]}
+        else:
+            req.trace = {"trace_id": telemetry.new_trace_id()}
         want_stream = bool(body.get("stream", True))
         if want_stream:
             # the client may advertise a SMALLER buffer than the
@@ -319,6 +328,9 @@ class _Handler(BaseHTTPRequestHandler):
                              "text/event-stream; charset=utf-8")
             self.send_header("Cache-Control", "no-store")
             self.send_header("X-Request-Id", req.id)
+            if req.trace:
+                self.send_header("traceparent", telemetry.format_traceparent(
+                    req.trace["trace_id"]))
             self.send_header("Connection", "close")
             self.end_headers()
         except _DISCONNECT_ERRORS:
@@ -392,9 +404,12 @@ class _Handler(BaseHTTPRequestHandler):
                       "completion_tokens": len(req.output_tokens)},
         }
         fe._code_inc(200)
+        hdrs = [("X-Request-Id", req.id)]
+        if req.trace:
+            hdrs.append(("traceparent", telemetry.format_traceparent(
+                req.trace["trace_id"])))
         try:
-            self._reply(body, code=200,
-                        headers=(("X-Request-Id", req.id),))
+            self._reply(body, code=200, headers=tuple(hdrs))
         except _DISCONNECT_ERRORS:
             fe._on_disconnect(req)
 

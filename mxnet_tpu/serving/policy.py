@@ -73,13 +73,22 @@ class SheddingPolicy:
         `_preempt_slot`; needs `host_kv_bytes` on the engine). Off by
         default: preemption beats shedding only when the host tier
         exists to keep the partial work.
+    slo: an SLOEngine (default: the process-global
+        `telemetry.slo.slo_engine`; pass False to disable). Any
+        objective whose FAST window is burning error budget at >=
+        `fast_burn` counts toward overload exactly like a blown TTFT
+        p99 — the multi-window burn rate reacts in ~1 min where the
+        raw p99 needs the histogram to rotate, so shedding starts
+        while there is still budget left to protect. Evaluation is
+        throttled to `slo_eval_interval_s` (assess runs on every
+        submit AND every step; burn rates move on window timescales).
     """
 
     def __init__(self, ttft_slo_ms=None, queue_low=None, queue_high=None,
                  shed_priority_floor=0, min_ttft_samples=8,
                  deadline_headroom=1.0, degrade_after=3,
                  recover_after=6, tenant_queue_share=None,
-                 preempt=False):
+                 preempt=False, slo=None, slo_eval_interval_s=0.25):
         self.ttft_slo_ms = ttft_slo_ms
         self.queue_low = queue_low
         self.queue_high = queue_high
@@ -94,6 +103,9 @@ class SheddingPolicy:
         if self.tenant_queue_share is not None \
                 and not 0.0 < self.tenant_queue_share <= 1.0:
             raise ValueError("tenant_queue_share must be in (0, 1]")
+        self.slo = slo             # None → global engine; False → off
+        self.slo_eval_interval_s = float(slo_eval_interval_s)
+        self._slo_last = None      # (clock_t, frozenset(burning names))
         self._hot = 0              # consecutive overloaded ticks
         self._cool = 0             # consecutive non-overloaded ticks
         self.level = 0
@@ -116,15 +128,36 @@ class SheddingPolicy:
         p99 = h.percentile(99)
         return (not math.isnan(p99)) and p99 * 1e3 > self.ttft_slo_ms
 
+    def _slo_burning(self, engine):
+        """Objective names whose fast window is burning, re-evaluated
+        at most every `slo_eval_interval_s` (assess runs per submit
+        and per step; burn rates only move on window timescales)."""
+        if self.slo is False:
+            return ()
+        eng = self.slo
+        if eng is None:
+            from .. import telemetry
+            eng = telemetry.slo.slo_engine
+        if not eng.objectives:
+            return ()
+        t = engine._clock()
+        if self._slo_last is not None \
+                and t - self._slo_last[0] < self.slo_eval_interval_s:
+            return self._slo_last[1]
+        burning = tuple(eng.fast_burning())
+        self._slo_last = (t, burning)
+        return burning
+
     def assess(self, engine):
         """Current overload level from live telemetry (also stored on
         `.level` and published as serving_overload_level)."""
         q = engine.scheduler.num_queued
         low, high = self._watermarks(engine)
         ttft_blown = self._ttft_blown(engine)
-        if q >= high or (ttft_blown and q >= low):
+        burning = bool(self._slo_burning(engine))
+        if q >= high or ((ttft_blown or burning) and q >= low):
             level = 2
-        elif q >= low or ttft_blown or (
+        elif q >= low or ttft_blown or burning or (
                 q > 0 and engine.admission_capacity_estimate()
                 <= engine.scheduler.num_active):
             level = 1
@@ -222,6 +255,9 @@ class SheddingPolicy:
             "recover_after": self.recover_after,
             "tenant_queue_share": self.tenant_queue_share,
             "preempt": self.preempt,
+            "slo_eval_interval_s": self.slo_eval_interval_s,
+            "slo_burning": list(self._slo_last[1])
+            if self._slo_last else [],
             "level": self.level,
             "downgrades": self.downgrades,
         }

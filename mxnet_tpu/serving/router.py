@@ -642,7 +642,11 @@ class ServingRouter:
                 top_p=req.top_p, seed=req.seed,
                 eos_token_id=req.eos_token_id, priority=req.priority,
                 deadline_ms=req.deadline_ms, adapter_id=req.adapter_id,
-                tenant=req.tenant)
+                tenant=req.tenant,
+                # the clone races the SAME logical request — it shares
+                # the original's trace id so both attempts correlate
+                # to one distributed trace
+                trace=dict(req.trace) if req.trace else None)
             try:
                 self.replicas[tgt].engine.submit(clone)
             except RejectedError:

@@ -73,12 +73,19 @@ class Request:
     tenant: accounting/quota label for multi-tenant admission; None =
     the anonymous default tenant. Both ride along through migration
     (export/adopt) and restart continuations.
+
+    trace: distributed trace context (dict with "trace_id" and
+    optionally "parent_span"/"t_begin") — the HTTP edge seeds it from
+    an incoming `traceparent` header, hedged clones copy it, and
+    export/adopt migration packs the accumulated timeline into it, so
+    one request is ONE trace wherever it runs (docs/OBSERVABILITY.md
+    "Trace propagation"). None = the engine mints an id at submit.
     """
 
     def __init__(self, prompt, max_new_tokens, request_id=None,
                  do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
                  seed=0, eos_token_id=None, priority=1, deadline_ms=None,
-                 adapter_id=None, tenant=None):
+                 adapter_id=None, tenant=None, trace=None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size == 0:
             raise MXNetError("Request needs a non-empty prompt")
@@ -105,10 +112,16 @@ class Request:
             else float(deadline_ms)
         self.adapter_id = adapter_id
         self.tenant = tenant
+        self.trace = dict(trace) if trace else None
         # filled in by the engine
         self.status = "new"
         self.output_tokens = []
+        # TTFT phase budget (engine `_phase`): phase name -> seconds;
+        # rides the Request through export/adopt so a migrated
+        # request's decomposition stays continuous
+        self.phases = {}
         self.t_submit = None
+        self.t_enqueue = None        # last queue entry, engine clock
         self.t_admit = None
         self.t_finish = None
         self.t_deadline = None       # absolute, engine clock domain

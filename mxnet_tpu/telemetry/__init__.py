@@ -60,6 +60,8 @@ from .tracing import (  # noqa: F401
 )
 from .request_trace import (  # noqa: F401
     RequestTrace, RequestTraceLog, request_log, chrome_trace,
+    PHASES, new_trace_id, new_span_id, parse_traceparent,
+    format_traceparent,
 )
 from .server import (  # noqa: F401
     IntrospectionServer, serve, stop_server, get_server,
@@ -71,6 +73,8 @@ from . import cost  # noqa: F401
 from . import flight  # noqa: F401
 from . import ledger  # noqa: F401
 from . import memory  # noqa: F401
+from . import slo  # noqa: F401
+from .slo import SLO, slo_engine  # noqa: F401
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry",
            "DEFAULT_LATENCY_BUCKETS", "exponential_buckets",
@@ -79,7 +83,10 @@ __all__ = ["Counter", "Gauge", "Histogram", "Registry",
            "span", "events", "clear_events", "enable_jsonl",
            "disable_jsonl", "add_event_hook", "remove_event_hook",
            "RequestTrace", "RequestTraceLog", "request_log",
-           "chrome_trace", "IntrospectionServer", "serve",
+           "chrome_trace", "PHASES", "new_trace_id", "new_span_id",
+           "parse_traceparent", "format_traceparent",
+           "SLO", "slo_engine", "slo",
+           "IntrospectionServer", "serve",
            "stop_server", "get_server", "register_status_provider",
            "unregister_status_provider", "collect_status",
            "register_ready_probe", "unregister_ready_probe",
@@ -132,3 +139,4 @@ def reset():
     default_registry.reset()
     clear_events()
     request_log.clear()
+    slo.slo_engine.clear()

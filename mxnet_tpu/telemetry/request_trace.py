@@ -18,17 +18,35 @@ re-anchored to the wall clock captured at import. That keeps every
 what makes the exported `ts`/`dur` pairs internally consistent — a
 child dispatch slice always nests inside its request's lifetime slice.
 
+Distributed context (docs/OBSERVABILITY.md "Trace propagation"): every
+trace carries a W3C trace-context id. The HTTP edge parses an incoming
+`traceparent` header (or mints a fresh id) and the id rides the
+Request through router placement, hedged clones, and
+export/adopt migration — `begin(trace_id=..., t_begin=...,
+phases=...)` re-opens a migrated request's timeline as a CONTINUATION
+(same trace id, preserved start, accumulated phase budget) instead of
+an orphan restart.
+
+TTFT phase budget (docs/OBSERVABILITY.md "Phase taxonomy"): `phase()`
+records one of the declared `PHASES` with a measured duration;
+per-trace accumulation makes a request's time-to-first-token decompose
+into queue_wait + prefix_match + host_pagein + prefill_chunks +
+first_decode. Phase names are CLOSED — an undeclared name raises here
+and graftlint's `phases` pass flags the literal statically.
+
 Zero dependencies: stdlib only, like the rest of `mx.telemetry`.
 """
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from collections import deque
 
 __all__ = ["RequestTrace", "RequestTraceLog", "request_log",
-           "chrome_trace", "now"]
+           "chrome_trace", "now", "PHASES", "new_trace_id",
+           "new_span_id", "parse_traceparent", "format_traceparent"]
 
 # one monotonic wall clock for every lifecycle/span timestamp
 _EPOCH = time.time() - time.perf_counter()
@@ -39,27 +57,111 @@ def now():
     return _EPOCH + time.perf_counter()
 
 
+#: The closed set of TTFT phase names. A request's time-to-first-token
+#: decomposes into exactly these (docs/OBSERVABILITY.md "Phase
+#: taxonomy"); `RequestTraceLog.phase()` rejects anything else and the
+#: graftlint `phases` pass checks recorded literals statically.
+PHASES = ("queue_wait", "prefix_match", "host_pagein",
+          "prefill_chunks", "first_decode")
+
+# -- W3C trace-context (traceparent) helpers ----------------------------------
+# Header shape: "00-<32 hex trace-id>-<16 hex span-id>-<2 hex flags>".
+# This is the wire contract the HTTP edge speaks and the cross-process
+# split (ROADMAP item 1) will reuse verbatim.
+
+
+def new_trace_id():
+    """Fresh 32-hex-char W3C trace id (never all zeros)."""
+    while True:
+        t = os.urandom(16).hex()
+        if t != "0" * 32:
+            return t
+
+
+def new_span_id():
+    """Fresh 16-hex-char W3C span id (never all zeros)."""
+    while True:
+        s = os.urandom(8).hex()
+        if s != "0" * 16:
+            return s
+
+
+def _is_hex(s):
+    try:
+        int(s, 16)
+        return True
+    except ValueError:
+        return False
+
+
+def parse_traceparent(header):
+    """(trace_id, span_id) from a `traceparent` header value, or None
+    when the header is absent/malformed (per spec, an invalid header is
+    IGNORED — the edge then starts a fresh trace, never 400s)."""
+    if not header or not isinstance(header, str):
+        return None
+    parts = header.strip().lower().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id = parts[0], parts[1], parts[2]
+    if len(version) != 2 or not _is_hex(version) or version == "ff":
+        return None
+    if len(trace_id) != 32 or not _is_hex(trace_id) \
+            or trace_id == "0" * 32:
+        return None
+    if len(span_id) != 16 or not _is_hex(span_id) \
+            or span_id == "0" * 16:
+        return None
+    if len(parts[3]) != 2 or not _is_hex(parts[3]):
+        return None
+    return trace_id, span_id
+
+
+def format_traceparent(trace_id, span_id=None, sampled=True):
+    """Render a `traceparent` header value for `trace_id` (a fresh
+    span id is minted when none is given)."""
+    flags = "01" if sampled else "00"
+    return f"00-{trace_id}-{span_id or new_span_id()}-{flags}"
+
+
 class RequestTrace:
     """One request's event timeline. Events are dicts with at least
     {"event", "ts"}; dispatch events carry "dur" (seconds) and counts.
-    `status` is None while live, then finished/cancelled/rejected."""
+    `status` is None while live, then finished/cancelled/rejected.
+
+    `trace_id` is the W3C id correlating this timeline across hops
+    (minted here when the caller has none). `phases` accumulates the
+    TTFT phase budget (phase name -> total seconds); a migrated
+    request's continuation is seeded with both so the stitched trace
+    reads as ONE request, not two."""
 
     __slots__ = ("request_id", "engine", "t_begin", "t_end", "status",
-                 "events", "attrs")
+                 "events", "attrs", "trace_id", "phases")
 
-    def __init__(self, request_id, engine="", **attrs):
+    def __init__(self, request_id, engine="", trace_id=None,
+                 t_begin=None, phases=None, **attrs):
         self.request_id = request_id
         self.engine = str(engine)
+        self.trace_id = trace_id or new_trace_id()
         self.t_begin = now()
         self.t_end = None
         self.status = None
         self.attrs = attrs
+        self.phases = dict(phases) if phases else {}
         self.events = [{"event": "enqueued", "ts": self.t_begin}]
+        if t_begin is not None:
+            # continuation of a migrated/re-homed timeline: keep the
+            # ORIGINAL start so queue->finish reads as one lifetime
+            self.t_begin = float(t_begin)
+            self.events[0]["ts"] = self.t_begin
+            self.events[0]["resumed_at"] = now()
 
     def to_dict(self):
         out = {"request_id": self.request_id, "engine": self.engine,
+               "trace_id": self.trace_id,
                "t_begin": self.t_begin, "t_end": self.t_end,
-               "status": self.status, "events": list(self.events)}
+               "status": self.status, "phases": dict(self.phases),
+               "events": list(self.events)}
         if self.attrs:
             out.update(self.attrs)
         return out
@@ -82,14 +184,46 @@ class RequestTraceLog:
         self.enabled = True
 
     # -- recording ---------------------------------------------------------
-    def begin(self, request_id, engine="", **attrs):
+    def begin(self, request_id, engine="", trace_id=None, t_begin=None,
+              phases=None, **attrs):
+        """Open a timeline. `trace_id`/`t_begin`/`phases` stitch a
+        migrated request's continuation onto its original trace
+        (export_requests packs them, adopt passes them back)."""
         if not self.enabled:
             return None
-        tr = RequestTrace(request_id, engine, **attrs)
+        tr = RequestTrace(request_id, engine, trace_id=trace_id,
+                          t_begin=t_begin, phases=phases, **attrs)
         with self._lock:
             self._live[(tr.engine, request_id)] = tr
         self._fire(tr, tr.events[0])
         return tr
+
+    def phase(self, request_id, engine="", phase="", dur=0.0, **attrs):
+        """Record one TTFT phase span (name MUST be in `PHASES` —
+        a typo'd phase would otherwise vanish silently into the ring)
+        and accumulate it into the trace's phase budget."""
+        if phase not in PHASES:
+            raise ValueError(f"unknown phase {phase!r} (declared: "
+                             f"{', '.join(PHASES)})")
+        if not self.enabled:
+            return None
+        dur = max(float(dur), 0.0)
+        ev = dict(event="phase", phase=phase, ts=now(), dur=dur, **attrs)
+        with self._lock:
+            tr = self._live.get((str(engine), request_id))
+            if tr is None:
+                return None
+            tr.phases[phase] = tr.phases.get(phase, 0.0) + dur
+            tr.events.append(ev)
+        self._fire(tr, ev)
+        return ev
+
+    def live_trace(self, request_id, engine=""):
+        """The live RequestTrace for (engine, request_id), or None —
+        export_requests reads trace_id/t_begin/phases off it to pack
+        the stitch context onto the migrating Request."""
+        with self._lock:
+            return self._live.get((str(engine), request_id))
 
     def event(self, request_id, engine="", event="", **attrs):
         if not self.enabled:
@@ -274,10 +408,17 @@ def chrome_trace(last_ms=None, requests=None, spans=None, max_requests=512):
                             "ts": _us(tr["t_begin"]),
                             "dur": max(_us(ev["ts"] - tr["t_begin"]), 0.0),
                             "pid": pid, "tid": tid, "args": eargs})
-            elif "dur" in ev:      # prefill / decode / verify phases
+            elif "dur" in ev:      # prefill / decode / verify / phase spans
                 dur = max(float(ev["dur"]), 0.0)
                 ts0 = max(ev["ts"] - dur, prev_ts)
-                out.append({"name": name, "cat": "dispatch", "ph": "X",
+                cat = "dispatch"
+                if name == "phase":
+                    # TTFT phase-budget span: named slice on the
+                    # request track so the waterfall reads directly
+                    name = ev.get("phase", "phase")
+                    cat = "phase"
+                    eargs.pop("phase", None)
+                out.append({"name": name, "cat": cat, "ph": "X",
                             "ts": _us(ts0),
                             "dur": _us(min(dur, t_end - ts0)),
                             "pid": pid, "tid": tid, "args": eargs})

@@ -33,6 +33,9 @@ introspection server"):
                  cost_analysis + MFU/roofline placement (telemetry.cost)
     /memz        JSON: the HBM ledger reconciled against live-array
                  bytes (telemetry.ledger)
+    /sloz        JSON: declared SLO objectives + multi-window burn
+                 rates (fast/slow windows, Google-SRE style) and which
+                 objectives are currently fast-burning (telemetry.slo)
 
 Every read is a snapshot under the instrument locks, so concurrent
 scrapes during serving never tear (tests/test_introspection.py soaks
@@ -295,6 +298,8 @@ _INDEX = """<!doctype html><title>mx.telemetry</title>
 <li><a href="/compilez">/compilez</a> — per-program compile
  attribution + MFU/roofline</li>
 <li><a href="/memz">/memz</a> — HBM ledger vs live-array bytes</li>
+<li><a href="/sloz">/sloz</a> — SLO objectives + multi-window
+ burn rates</li>
 <li><a href="/healthz">/healthz</a> — liveness (degraded while a
  flight dump is latched)</li>
 <li><a href="/readyz">/readyz</a> — readiness (warmed &and; not
@@ -353,6 +358,10 @@ class _Handler(BaseHTTPRequestHandler):
             elif url.path == "/memz":
                 from . import ledger
                 self._reply(json.dumps(ledger.snapshot(), indent=1,
+                                       sort_keys=True, default=str))
+            elif url.path == "/sloz":
+                from . import slo
+                self._reply(json.dumps(slo.snapshot(), indent=1,
                                        sort_keys=True, default=str))
             else:
                 self._reply(json.dumps({"error": "not found",

@@ -1,0 +1,19 @@
+"""Seeded phase-taxonomy violations: phase-name literals that are not
+in telemetry.PHASES — a typo'd name and an invented one. Parsed only,
+never imported."""
+
+
+class LeakyEngine:
+    def record_admit(self, req, dt):
+        # typo: "queue_wiat" is not "queue_wait"
+        self.request_log.phase(req.request_id, self.engine_id,
+                               "queue_wiat", dt)
+
+    def record_warmup(self, req, dt):
+        # invented phase outside the five-name taxonomy
+        self._phase(req, "warmup", dt)
+
+
+def report(log, rid, eng, dt):
+    # kwarg spelling of the same typo, on the bound log method
+    log.phase(rid, eng, dt, phase="first_decod")

@@ -1,0 +1,24 @@
+"""Near-misses the phases pass must NOT flag: valid taxonomy literals,
+a variable-carried name (the runtime check's job), a forwarding helper
+piping its argument through, and an unrelated `.phase` receiver with no
+string literal in the phase slot. Parsed only, never imported."""
+
+
+class CleanEngine:
+    def record_admit(self, req, dt):
+        self.request_log.phase(req.request_id, self.engine_id,
+                               "queue_wait", dt)
+
+    def record_pagein(self, req, dt):
+        self._phase(req, "host_pagein", dt)
+
+    def _phase(self, req, name, dt):
+        # forwarding helper: the name arrives in a variable
+        self.request_log.phase(req.request_id, self.engine_id, name, dt)
+
+
+def report(log, rid, eng, which, dt):
+    log.phase(rid, eng, which, dt)          # variable: runtime's job
+    log.phase(rid, eng, dt, phase="prefill_chunks")
+    moon = object()
+    return moon.phase                        # attribute, not a call

@@ -329,5 +329,5 @@ def test_prefill_pending_gauge_and_ttft_histogram():
     assert eng.stats["prefill_pending"] == 0
     assert eng.stats["prefill_chunks"] == 3      # ceil(20 / 8)
     h = telemetry.get("serving_ttft_by_prompt_seconds")
-    child = h.labels(str(eng._eid), "le32")      # 16 < 20 <= 32
+    child = h.labels(str(eng._eid), "le32", "cold")   # 16 < 20 <= 32
     assert child.count == 1

@@ -18,7 +18,7 @@ from mxnet_tpu.analysis import (BaselineError, Context, OwnershipError,
                                 claim_ownership, load_baseline,
                                 loop_only, repo_root, run_passes,
                                 set_assert_ownership, split_suppressed)
-from mxnet_tpu.analysis import (catalog, ownership, resources,
+from mxnet_tpu.analysis import (catalog, ownership, phases, resources,
                                 trace_safety)
 
 ROOT = repo_root()
@@ -100,6 +100,50 @@ def test_catalog_pass_catches_seeded_violations():
 def test_catalog_pass_spares_near_misses():
     doc = "| `documented_metric_total` | counter | ok |"
     assert catalog.run(_ctx("catalog_good.py", doc_text=doc)) == []
+
+
+# -- phase taxonomy --------------------------------------------------------
+
+# the pass reads the PHASES enum from this module's AST, so fixture
+# contexts must include it alongside the fixture under test
+_ENUM = os.path.join("mxnet_tpu", "telemetry", "request_trace.py")
+
+
+def _phase_ctx(name):
+    return Context(root=ROOT,
+                   paths=[os.path.join(FIXTURES, name), _ENUM])
+
+
+def test_phases_pass_catches_seeded_violations():
+    found = [f for f in phases.run(_phase_ctx("phases_bad.py"))
+             if f.path.startswith(FIXTURES)]
+    assert _rules(found) == {"phase-unknown-name"}
+    assert sorted(f.symbol for f in found) == [
+        "LeakyEngine.record_admit", "LeakyEngine.record_warmup",
+        "report"]
+    # the message names both the typo and the shared taxonomy
+    typo = next(f for f in found
+                if f.symbol == "LeakyEngine.record_admit")
+    assert "queue_wiat" in typo.message and "queue_wait" in typo.message
+
+
+def test_phases_pass_spares_near_misses():
+    assert [f for f in phases.run(_phase_ctx("phases_good.py"))
+            if f.path.startswith(FIXTURES)] == []
+
+
+def test_phases_enum_matches_runtime():
+    # the AST-parsed enum is the same tuple the runtime exports, so the
+    # lint can never drift from the real taxonomy
+    from mxnet_tpu import telemetry
+    enum = phases.phase_enum(Context(root=ROOT, paths=[_ENUM]))
+    assert enum == telemetry.PHASES
+    assert len(enum) == 5
+
+
+def test_phases_pass_silent_without_enum_in_view():
+    # partial lint of unrelated paths: no taxonomy, nothing to check
+    assert phases.run(_ctx("phases_bad.py")) == []
 
 
 # -- the repo itself is the real fixture -----------------------------------

@@ -48,7 +48,11 @@ __all__ = ["load_records", "compare", "main"]
 _LOWER_BETTER = ("latency", "_ms", "seconds", "bytes", "loss",
                  "overhead", "ttft", "ttfb", "mismatch", "page_in",
                  "eviction", "compiles", "shed", "pending", "makespan",
-                 "stall", "disconnect", "reprefill")
+                 "stall", "disconnect", "reprefill",
+                 # TTFT phase budget + SLO burn (ISSUE 17): time spent
+                 # in any phase and error-budget burn both want DOWN
+                 "queue_wait", "prefix_match", "pagein",
+                 "prefill_chunks", "first_decode", "burn_rate")
 
 # capacity/throughput names where MORE is the win — checked FIRST so a
 # lower-is-better token sharing the name (e.g. `bytes` inside

@@ -18,6 +18,7 @@ Usage:
     python tools/dump_telemetry.py --router   # multi-replica headline
     python tools/dump_telemetry.py --http     # HTTP-ingress headline
     python tools/dump_telemetry.py --kv       # tiered-KV headline
+    python tools/dump_telemetry.py --slo      # SLO burn-rate headline
 
 --trace writes the run's request timelines + spans as Chrome
 trace_event JSON (open in ui.perfetto.dev). --serve starts the live
@@ -290,6 +291,40 @@ def run_kv():
     return eng
 
 
+def run_slo():
+    """A tiny engine serving under two declared objectives — one
+    generous (stays green) and one deliberately blown (its fast window
+    burns budget immediately) — so the slo_* instruments, the /sloz
+    burn table, and the per-request phase budgets carry real values in
+    the dump."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.models import GPT2Config, GPT2ForCausalLM
+    from mxnet_tpu.serving import Request, ServingEngine
+
+    telemetry.slo.configure([
+        telemetry.SLO("ttft_generous", ttft_p99_ms=60_000.0,
+                      min_events=2),
+        telemetry.SLO("ttft_blown", ttft_p99_ms=0.01, min_events=2),
+    ])
+    cfg = GPT2Config(vocab_size=97, units=32, num_layers=2, num_heads=2,
+                     max_length=64, dropout=0.0, attention_dropout=0.0)
+    net = GPT2ForCausalLM(cfg)
+    mx.rng.seed(0)
+    net.initialize(mx.init.Normal(0.05))
+    eng = ServingEngine(net, num_slots=2, max_length=32, page_size=8,
+                        decode_block=2, attn_impl="xla")
+    rng = np.random.default_rng(0)
+    reqs = [Request(rng.integers(1, cfg.vocab_size, 5).tolist(), 3,
+                    seed=i, request_id=800 + i) for i in range(4)]
+    done = eng.serve(reqs)
+    assert len(done) == len(reqs)
+    telemetry.slo.slo_engine.evaluate()
+    return eng
+
+
 def run_training():
     import numpy as np
 
@@ -334,6 +369,10 @@ def main():
                     help="also run a multi-tenant LoRA engine (paged "
                          "adapter slab + tenant quotas) and print the "
                          "per-tenant headline")
+    ap.add_argument("--slo", action="store_true",
+                    help="also run an engine under declared SLO "
+                         "objectives (one green, one deliberately "
+                         "burning) and print the burn-rate headline")
     ap.add_argument("--kv", action="store_true",
                     help="also run a spill-pressured tiered-KV engine "
                          "(tiny page budget + host-RAM tier) and print "
@@ -364,12 +403,14 @@ def main():
     if args.spans:
         telemetry.enable_jsonl(args.spans)
     eng = spec = shed_eng = router = tenant_eng = frontend = None
-    kv_eng = None
+    kv_eng = slo_eng = None
     with telemetry.span("dump_telemetry.workloads"):
         if args.workload in ("serving", "both"):
             eng, spec = run_serving()
         if args.shed:
             shed_eng = run_shedding()
+        if args.slo:
+            slo_eng = run_slo()
         if args.tenants:
             tenant_eng = run_tenants()
         if args.kv:
@@ -422,6 +463,19 @@ def main():
               f"overload level {rb['overload_level']}, "
               f"degraded {'yes' if rb['degraded'] else 'no'}, "
               f"downgrades {rb['policy']['downgrades']}")
+    if slo_eng is not None:
+        # the SLO headline: what /sloz would show — per-objective
+        # fast/slow burn rates over their windows, and which
+        # objectives are currently burning fast enough to page
+        snap = telemetry.slo.snapshot()
+        rows = ", ".join(
+            f"{r['objective']}[fast {r['fast']['burn_rate']:.1f}x "
+            f"({r['fast']['bad']}/{r['fast']['events']} bad), "
+            f"slow {r['slow']['burn_rate']:.1f}x]"
+            for r in snap["series"])
+        burning = ", ".join(snap["fast_burning"]) or "none"
+        print(f"# slo: {rows or 'no objectives'}; "
+              f"fast-burning: {burning}")
     if tenant_eng is not None:
         # the multi-tenant headline: per-tenant fairness outcomes plus
         # how hard the adapter slab is paging
@@ -559,8 +613,13 @@ def main():
         print(f"# request timelines: {len(telemetry.request_log.recent(10**6))}"
               " recorded; most recent:")
         for tr in timelines[-4:]:
-            evs = ",".join(e["event"] for e in tr["events"])
-            print(f"#   req {tr['request_id']} [{tr['status']}] {evs}")
+            evs = ",".join(e["event"] for e in tr["events"]
+                           if e["event"] != "phase")
+            ph = tr.get("phases") or {}
+            extra = "" if not ph else " | " + " ".join(
+                f"{k}={v * 1e3:.1f}ms" for k, v in ph.items())
+            print(f"#   req {tr['request_id']} [{tr['status']}] "
+                  f"{evs}{extra}")
     if args.trace:
         with open(args.trace, "w") as f:
             json.dump(telemetry.chrome_trace(), f)

@@ -26,6 +26,13 @@ Pass criteria (exit 0 only if ALL hold):
     == disconnects observed), and any overflow the frontend counted
     reached its client as a structured `error` event;
   * the scheduled replica kill fired and the fleet kept serving;
+  * trace + span accounting: every 200 response echoes the client's
+    W3C traceparent trace id and the engine timelines adopted it
+    (including across a kill-migration); every first-token timeline's
+    phase budget (queue_wait/prefix_match/host_pagein/prefill_chunks/
+    first_decode) never exceeds the engine TTFT, sums to it within
+    5 ms for undisturbed requests, and the client-observed TTFB is
+    never below the engine TTFT for fully-read streams;
   * steady_state_compiles == 0 on every replica after warmup — the
     chaos (kills, migrations, cancels, overflows) must not retrace;
   * graceful drain works: after begin_drain() a probe request gets
@@ -92,27 +99,36 @@ class _Client:
     """One soak client: POSTs over a raw socket and reads according
     to its seeded behavior. Records everything for the verdict."""
 
-    def __init__(self, idx, behavior, body, cutoff=None, stall_s=0.0):
+    def __init__(self, idx, behavior, body, cutoff=None, stall_s=0.0,
+                 traceparent=None):
         self.idx = idx
         self.behavior = behavior      # "read" | "hangup" | "slow"
         self.body = body
         self.cutoff = cutoff          # hangup: bytes to read first
         self.stall_s = stall_s        # slow: stall after first tokens
+        self.traceparent = traceparent
         self.status = None
         self.headers = {}
         self.raw = b""
         self.error = None
+        self.t_sent = None            # request bytes on the wire
+        self.t_first = None           # first token event bytes seen
 
     def run(self, host, port):
         try:
             payload = json.dumps(self.body).encode()
+            head = (b"POST /v1/generate HTTP/1.0\r\n"
+                    b"Content-Type: application/json\r\n")
+            if self.traceparent:
+                head += (b"traceparent: "
+                         + self.traceparent.encode() + b"\r\n")
             sock = socket.create_connection((host, port), timeout=300)
             try:
                 sock.sendall(
-                    b"POST /v1/generate HTTP/1.0\r\n"
-                    b"Content-Type: application/json\r\n"
-                    b"Content-Length: " + str(len(payload)).encode()
+                    head + b"Content-Length: "
+                    + str(len(payload)).encode()
                     + b"\r\n\r\n" + payload)
+                self.t_sent = time.perf_counter()
                 stalled = False
                 while True:
                     if self.behavior == "hangup" \
@@ -122,6 +138,9 @@ class _Client:
                     if not chunk:
                         break
                     self.raw += chunk
+                    if self.t_first is None \
+                            and b"event: tokens" in self.raw:
+                        self.t_first = time.perf_counter()
                     if (self.behavior == "slow" and not stalled
                             and b"event: tokens" in self.raw):
                         # fall behind for real: the server keeps
@@ -322,14 +341,20 @@ def main(argv=None):
 
     clients = []
     for i, (beh, body) in enumerate(zip(behaviors, bodies)):
+        # every client propagates a W3C trace context; the verdict
+        # checks the response echoes the SAME trace id and that the
+        # engine-side timeline adopted it (docs/OBSERVABILITY.md
+        # "Trace propagation")
+        tp = f"00-{i + 1:032x}-{i + 1:016x}-01"
         if beh == "read":
-            c = _Client(i, "read", body)
+            c = _Client(i, "read", body, traceparent=tp)
         elif beh == "hangup":
             c = _Client(i, "hangup", body,
-                        cutoff=int(rng.integers(0, 600)))
+                        cutoff=int(rng.integers(0, 600)), traceparent=tp)
         else:
             c = _Client(i, "slow", body,
-                        stall_s=float(rng.uniform(1.0, 1.6)))
+                        stall_s=float(rng.uniform(1.0, 1.6)),
+                        traceparent=tp)
         clients.append(c)
     arrivals = np.cumsum(rng.exponential(1.0 / args.rate,
                                          args.requests))
@@ -479,6 +504,70 @@ def main(argv=None):
               f"overflow accounting: counted {st['stream_overflows']}, "
               f"clients saw {overflows_seen} error events")
 
+        # -- trace + span accounting --------------------------------------
+        # the TTFT phase budget must reconcile with what both sides
+        # measured: phases never overcount the engine TTFT, sum to it
+        # exactly for undisturbed requests, and the engine can never
+        # claim a first token later than the client saw bytes
+        from mxnet_tpu import telemetry
+        fleet = {str(e._eid) for e in engines}
+        per_req = {}
+        for tr in telemetry.request_log.recent(10**6):
+            rid = str(tr["request_id"])
+            if str(tr["engine"]) in fleet and rid.startswith("soak-"):
+                per_req.setdefault(rid, []).append(tr)
+        disturb = {"requeued", "preempted", "resumed", "resumed_swap",
+                   "hedged", "swap_stale", "decode_discarded"}
+        spans = strict = trace_prop = ttfb_ok = 0
+        for c in clients:
+            rid = f"soak-{c.idx}"
+            trs = per_req.get(rid, [])
+            if c.status == 200 and c.traceparent:
+                want = c.traceparent.split("-")[1]
+                got = (c.headers.get("traceparent") or "").split("-")
+                check(len(got) == 4 and got[1] == want,
+                      f"client {c.idx}: traceparent not echoed "
+                      f"({c.headers.get('traceparent')!r})")
+                check(all(tr["trace_id"] == want for tr in trs),
+                      f"client {c.idx}: engine timeline dropped the "
+                      f"propagated trace id "
+                      f"({[tr['trace_id'] for tr in trs]})")
+                trace_prop += 1
+            fts = [(tr, ev) for tr in trs for ev in tr["events"]
+                   if ev["event"] == "first_token"]
+            if not fts:
+                continue              # cancelled/killed pre-first-token
+            tr, ev = fts[-1]
+            ttft = float(ev["ttft"])
+            ph = tr.get("phases") or {}
+            total = sum(ph.values())
+            # the budget may undercount (requeue/migration gaps are
+            # nobody's phase) but must never overcount
+            check(total <= ttft + 0.005,
+                  f"{rid}: phase sum {total * 1e3:.1f} ms > TTFT "
+                  f"{ttft * 1e3:.1f} ms (phases {ph})")
+            spans += 1
+            clean = (len(trs) == 1
+                     and "resumed_at" not in tr["events"][0]
+                     and not any(e["event"] in disturb
+                                 for e in tr["events"]))
+            if clean:
+                check(abs(total - ttft) <= 0.005,
+                      f"{rid}: clean request's phases sum to "
+                      f"{total * 1e3:.1f} ms vs TTFT {ttft * 1e3:.1f} "
+                      f"ms — the budget must account the whole TTFT")
+                strict += 1
+            if c.behavior == "read" and c.status == 200 \
+                    and c.t_first is not None and c.t_sent is not None:
+                ttfb = c.t_first - c.t_sent
+                check(ttfb + 1e-3 >= ttft,
+                      f"{rid}: client TTFB {ttfb * 1e3:.1f} ms < engine "
+                      f"TTFT {ttft * 1e3:.1f} ms — the engine cannot "
+                      f"emit before the client asked")
+                ttfb_ok += 1
+        check(spans > 0, "span accounting: no first_token timelines "
+                         "recorded (request log disabled?)")
+
         fe.shutdown(timeout=60)
         check(not fe._loop_thread.is_alive(), "serving loop still alive")
         s = socket.socket()
@@ -514,6 +603,12 @@ def main(argv=None):
         "steady_state_compiles": {
             f"engine{e._eid}": _compiles(e._eid) - compiles_at_warm[e._eid]
             for e in engines},
+        "span_accounting": {
+            "first_token_timelines": spans,
+            "clean_exact": strict,
+            "client_ttfb_vs_engine_ttft": ttfb_ok,
+            "traceparent_round_trips": trace_prop,
+        },
         "kv_tier": None if not tiered else {
             "kv_spill_pages": sum(e.stats["kv_spill_pages"]
                                   for e in engines),

@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Render TTFT phase waterfalls from a Chrome trace dump.
+
+The serving engine decomposes every request's time-to-first-token into
+the five budget phases of `telemetry.PHASES` (queue_wait,
+prefix_match, host_pagein, prefill_chunks, first_decode —
+docs/OBSERVABILITY.md "TTFT phase taxonomy") and exports them as
+`cat="phase"` complete events in the Chrome trace
+(`telemetry.chrome_trace()`, `/trace`, `dump_telemetry.py --trace`).
+ui.perfetto.dev renders those interactively; this tool answers the
+batch question — "where did TTFT go across this run?" — in a
+terminal:
+
+  * a per-request WATERFALL for the slowest requests: each phase as
+    an offset bar inside the request's own window, so a long
+    queue_wait reads differently from a long host_pagein at a glance.
+    A request migrated across engines (replica kill, preempt-resume)
+    shows as ONE waterfall — phase events are grouped by request id,
+    which the trace-context stitching keeps stable across adoption.
+  * a PHASE-SHARE table over every request: total / share / count /
+    mean / max per phase — the fleet-level budget split that tells
+    you which phase to optimize next.
+
+Usage:
+    python tools/dump_telemetry.py --trace trace.json
+    python tools/trace_report.py trace.json [--top 8] [--width 40]
+        [--share-only]
+
+Exit codes: 0 = rendered, 2 = unreadable input or no phase events in
+the trace (nothing served, or the request log was disabled).
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# phase display order = budget order; mirrors telemetry.PHASES without
+# importing jax (this tool must run on a bare trace file anywhere)
+PHASE_ORDER = ("queue_wait", "prefix_match", "host_pagein",
+               "prefill_chunks", "first_decode")
+
+__all__ = ["load_events", "collect", "main"]
+
+
+def load_events(path):
+    with open(path) as f:
+        obj = json.load(f)
+    return obj["traceEvents"] if isinstance(obj, dict) else obj
+
+
+def collect(events):
+    """({request_name: [phase event, ...]}, {(pid, tid): request_name},
+    {pid: engine_name}) from one trace. Grouping by the request's
+    display name ("req <id>") folds a migrated request's engines into
+    one timeline."""
+    threads, procs = {}, {}
+    for ev in events:
+        if ev.get("ph") != "M":
+            continue
+        if ev.get("name") == "thread_name":
+            threads[(ev.get("pid"), ev.get("tid"))] = ev["args"]["name"]
+        elif ev.get("name") == "process_name":
+            procs[ev.get("pid")] = ev["args"]["name"]
+    by_req = {}
+    for ev in events:
+        if ev.get("cat") != "phase" or ev.get("ph") != "X":
+            continue
+        key = threads.get((ev.get("pid"), ev.get("tid")),
+                          f"tid {ev.get('tid')}")
+        by_req.setdefault(key, []).append(ev)
+    return by_req, threads, procs
+
+
+def _bar(offset, dur, total, width):
+    """One offset bar: '·' padding to the phase start, '█' for its
+    extent (always >= 1 cell so microsecond phases stay visible)."""
+    if total <= 0:
+        return "·" * width
+    a = int(round(offset / total * width))
+    b = max(1, int(round(dur / total * width)))
+    a = min(a, width - 1)
+    b = min(b, width - a)
+    return "·" * a + "█" * b + "·" * (width - a - b)
+
+
+def _phase_key(name):
+    try:
+        return (PHASE_ORDER.index(name), name)
+    except ValueError:
+        return (len(PHASE_ORDER), name)
+
+
+def render_waterfalls(by_req, procs, top, width, out=print):
+    # slowest first: ranked by summed phase time (the TTFT budget)
+    ranked = sorted(by_req.items(),
+                    key=lambda kv: -sum(e["dur"] for e in kv[1]))
+    for name, evs in ranked[:top]:
+        t0 = min(e["ts"] for e in evs)
+        t1 = max(e["ts"] + e["dur"] for e in evs)
+        total = t1 - t0
+        engines = sorted({procs.get(e.get("pid"), f"pid {e.get('pid')}")
+                          for e in evs})
+        budget = sum(e["dur"] for e in evs)
+        out(f"{name}  ({', '.join(engines)})  "
+            f"phase budget {budget / 1e3:.1f} ms"
+            + ("  [migrated]" if len(engines) > 1 else ""))
+        for ev in sorted(evs, key=lambda e: (e["ts"],
+                                             _phase_key(e["name"]))):
+            extra = "".join(f" {k}={v}" for k, v in
+                            sorted((ev.get("args") or {}).items()))
+            out(f"  {ev['name']:<15}{ev['dur'] / 1e3:>9.2f} ms  "
+                f"|{_bar(ev['ts'] - t0, ev['dur'], total, width)}|"
+                f"{extra}")
+        out("")
+
+
+def render_share(by_req, out=print):
+    agg = {}                              # phase -> [total_us, n, max]
+    for evs in by_req.values():
+        for ev in evs:
+            a = agg.setdefault(ev["name"], [0.0, 0, 0.0])
+            a[0] += ev["dur"]
+            a[1] += 1
+            a[2] = max(a[2], ev["dur"])
+    grand = sum(a[0] for a in agg.values()) or 1.0
+    out(f"{'phase':<15}{'total_ms':>10}{'share':>8}{'count':>7}"
+        f"{'mean_ms':>9}{'max_ms':>9}")
+    out("-" * 58)
+    for name in sorted(agg, key=_phase_key):
+        tot, n, mx = agg[name]
+        out(f"{name:<15}{tot / 1e3:>10.1f}{tot / grand:>8.1%}{n:>7}"
+            f"{tot / n / 1e3:>9.2f}{mx / 1e3:>9.2f}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="TTFT phase waterfall + share table from a Chrome "
+                    "trace (dump_telemetry.py --trace / the /trace "
+                    "endpoint)")
+    ap.add_argument("trace", help="Chrome trace_event JSON file")
+    ap.add_argument("--top", type=int, default=8,
+                    help="waterfalls for the N slowest requests "
+                         "(default 8)")
+    ap.add_argument("--width", type=int, default=40,
+                    help="waterfall bar width in cells (default 40)")
+    ap.add_argument("--share-only", action="store_true",
+                    help="skip the waterfalls, print only the "
+                         "phase-share table")
+    args = ap.parse_args(argv)
+
+    try:
+        events = load_events(args.trace)
+    except (OSError, ValueError) as e:
+        print(f"ERROR: cannot read {args.trace}: {e}")
+        return 2
+    by_req, _, procs = collect(events)
+    if not by_req:
+        print("ERROR: no phase events in the trace — nothing was "
+              "served, or telemetry.request_log was disabled")
+        return 2
+    n_ph = sum(len(v) for v in by_req.values())
+    print(f"# {len(by_req)} request(s), {n_ph} phase spans "
+          f"({os.path.basename(args.trace)})\n")
+    if not args.share_only:
+        render_waterfalls(by_req, procs, args.top, max(10, args.width))
+    render_share(by_req)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
