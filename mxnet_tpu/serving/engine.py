@@ -661,50 +661,53 @@ class ServingEngine:
                                           evict_cb=self._host_evict)
             self.prefix_cache.evict_hook = self._spill_node
             self.prefix_cache.pagein_hook = self._pagein_nodes
-            # tier transfer programs: ONE fixed index width (P = pages
-            # per slot) however many pages move. Gather pads its index
-            # with page 0 and the host slices the valid prefix after
-            # device_get; scatter pads with an out-of-range id that
-            # mode="drop" ignores. Gather must NOT donate (the pools
-            # live on); scatter donates them like every dispatch.
-            # under tp>1 the scatter pins out_shardings to the pools'
-            # own shardings: the donated outputs must come back in
-            # EXACTLY the layout the dispatch expects (XLA would
-            # otherwise return a spec-normalized NamedSharding that
-            # misses the dispatch cache key). tp=1 must NOT pin — the
-            # pool chain is uncommitted end to end, and committing it
-            # here would mint a second pjit entry in every downstream
-            # page program.
-            pin = self._tp > 1
-            if self._quant:
-                def _tier_gather_q(kp, vp, ks, vs, idx):
-                    return gather_kv_pages(kp, vp, idx, ks, vs)
+        # tier transfer programs: ONE fixed index width (P = pages
+        # per slot) however many pages move. Gather pads its index
+        # with page 0 and the host slices the valid prefix after
+        # device_get; scatter pads with an out-of-range id that
+        # mode="drop" ignores. Gather must NOT donate (the pools
+        # live on); scatter donates them like every dispatch.
+        # under tp>1 the scatter pins out_shardings to the pools'
+        # own shardings: the donated outputs must come back in
+        # EXACTLY the layout the dispatch expects (XLA would
+        # otherwise return a spec-normalized NamedSharding that
+        # misses the dispatch cache key). tp=1 must NOT pin — the
+        # pool chain is uncommitted end to end, and committing it
+        # here would mint a second pjit entry in every downstream
+        # page program. Built whether or not a host tier is on: the
+        # same movers carry the cross-process prefill->decode handoff
+        # (export_handoff / _adopt_payload, serving/fleet) — jit is
+        # lazy, so an engine that never moves a page never traces them.
+        pin = self._tp > 1
+        if self._quant:
+            def _tier_gather_q(kp, vp, ks, vs, idx):
+                return gather_kv_pages(kp, vp, idx, ks, vs)
 
-                def _tier_scatter_q(kp, vp, ks, vs, idx, kv, vv,
-                                    ksv, vsv):
-                    return scatter_kv_pages(kp, vp, idx, kv, vv,
-                                            ks, vs, ksv, vsv)
+            def _tier_scatter_q(kp, vp, ks, vs, idx, kv, vv,
+                                ksv, vsv):
+                return scatter_kv_pages(kp, vp, idx, kv, vv,
+                                        ks, vs, ksv, vsv)
 
-                self._tier_gather_fn = jax.jit(_tier_gather_q)
-                self._tier_scatter_fn = jax.jit(
-                    _tier_scatter_q, donate_argnums=(0, 1, 2, 3),
-                    out_shardings=(
-                        (self._kp.sharding, self._vp.sharding,
-                         self._ks.sharding, self._vs.sharding)
-                        if pin else None))
-            else:
-                def _tier_gather_f(kp, vp, idx):
-                    return gather_kv_pages(kp, vp, idx)[:2]
+            self._tier_gather_fn = jax.jit(_tier_gather_q)
+            self._tier_scatter_fn = jax.jit(
+                _tier_scatter_q, donate_argnums=(0, 1, 2, 3),
+                out_shardings=(
+                    (self._kp.sharding, self._vp.sharding,
+                     self._ks.sharding, self._vs.sharding)
+                    if pin else None))
+        else:
+            def _tier_gather_f(kp, vp, idx):
+                return gather_kv_pages(kp, vp, idx)[:2]
 
-                def _tier_scatter_f(kp, vp, idx, kv, vv):
-                    return scatter_kv_pages(kp, vp, idx, kv, vv)[:2]
+            def _tier_scatter_f(kp, vp, idx, kv, vv):
+                return scatter_kv_pages(kp, vp, idx, kv, vv)[:2]
 
-                self._tier_gather_fn = jax.jit(_tier_gather_f)
-                self._tier_scatter_fn = jax.jit(
-                    _tier_scatter_f, donate_argnums=(0, 1),
-                    out_shardings=(
-                        (self._kp.sharding, self._vp.sharding)
-                        if pin else None))
+            self._tier_gather_fn = jax.jit(_tier_gather_f)
+            self._tier_scatter_fn = jax.jit(
+                _tier_scatter_f, donate_argnums=(0, 1),
+                out_shardings=(
+                    (self._kp.sharding, self._vp.sharding)
+                    if pin else None))
         # per-slot page tables are HOST state now (page-table surgery at
         # admission); uploaded with each dispatch
         self._table_host = np.zeros((B, P), np.int32)
@@ -1612,6 +1615,63 @@ class ServingEngine:
         self._set_pool_gauges()
         return out
 
+    @loop_only
+    def export_handoff(self, request_id):
+        """Export ONE decoding request WITH its device KV — the
+        prefill->decode handoff seam (serving/fleet, docs/SERVING.md
+        "Disaggregated prefill/decode"). The slot's used pages (codes
+        AND the int8 scale leaves, via the tier gather) and the decode
+        cursor scalars land in `req.kv_payload`; the slot and every
+        lease release; the timeline ends "migrated" with the stitch
+        context packed like export_requests. An engine that adopts the
+        payload (`_adopt_payload`) scatters the pages back verbatim and
+        continues decoding bit-identically with no re-prefill.
+
+        Returns None when the request is not actively decoding here:
+        already terminal, never admitted, or still mid-prefill (its
+        un-fed chunk queue is host state the payload format does not
+        carry — the caller retries after the final chunk lands)."""
+        slot = None
+        for s in self.scheduler.active_slots:
+            if self.scheduler.request_at(s).id == request_id:
+                slot = s
+                break
+        if slot is None:
+            return None
+        req = self.scheduler.request_at(slot)
+        if self._pending[slot] is not None or not req.output_tokens:
+            return None         # mid-prefill: nothing decodable yet
+        length = int(self._lengths[slot])
+        n_used = min(self._pages_per_slot,
+                     -(-length // self.page_size))
+        row = [int(p) for p in self._table_host[slot][:n_used]]
+        req.kv_payload = {
+            "length": length,
+            "cur_tok": int(self._cur_tok[slot]),
+            "remaining": int(self._remaining[slot]),
+            "counters": int(self._counters[slot]),
+            "pages": self._tier_gather(row),
+            # wall-clock stamp (telemetry's re-anchored perf_counter):
+            # the ONLY clock an adopting PROCESS shares with us — the
+            # adopter's "handoff" phase measures from here
+            "t_export": telemetry.request_trace.now(),
+        }
+        self._drop_swap(req)
+        self._release_slot(slot)
+        req.status = "exported"
+        tr = telemetry.request_log.live_trace(req.id, self._eid)
+        if tr is not None:
+            t = dict(getattr(req, "trace", None) or {})
+            t.setdefault("trace_id", tr.trace_id)
+            t["t_begin"] = tr.t_begin
+            req.trace = t
+        telemetry.request_log.end(
+            req.id, self._eid, "migrated", reason="handoff",
+            tokens=len(req.output_tokens))
+        self._set_load_gauges()
+        self._set_pool_gauges()
+        return req
+
     @property
     def has_work(self):
         return self.scheduler.has_work
@@ -2484,6 +2544,98 @@ class ServingEngine:
         self._set_pool_gauges()
         return True
 
+    def _adopt_payload(self, slot, req):
+        """Splice a handed-off request straight into decode from its
+        shipped KV payload (export_handoff on the exporting engine,
+        possibly in another PROCESS): a full row of fresh exclusive
+        pages, one batched scatter of the shipped page slabs — int8
+        codes and their scale leaves land verbatim, so no
+        re-quantization and no replay — and the decode cursor restored
+        from the payload scalars. The continuation is bit-identical to
+        the exporter having kept decoding. Returns False when the
+        payload cannot land here (geometry/dtype mismatch, page-pool
+        pressure): the caller falls back to the replay restart, which
+        reaches the same tokens by recomputing."""
+        kvp = req.kv_payload
+        pages = kvp.get("pages") or []
+        length = int(kvp.get("length", -1))
+        P, S = self._pages_per_slot, self.page_size
+        if (not pages or length < 1 or length > self.max_length
+                or len(pages) != min(P, -(-length // S))):
+            return False
+        L, _, S_, H, Dh = self._kp.shape
+        k0 = np.asarray(pages[0].get("k"))
+        if k0.shape != (L, S_, H, Dh) \
+                or k0.dtype != np.dtype(self._kp.dtype) \
+                or self._quant != ("ks" in pages[0]):
+            return False
+        pc = self.prefix_cache
+        try:
+            try:
+                if pc is not None and self.page_pool.num_free < P:
+                    pc.reclaim(P)
+                fresh = self.page_pool.alloc(P)
+            except Exception:   # noqa: BLE001 — pool pressure: replay
+                return False
+            if self._quant:
+                # recycled pages must start from scale 0 before the
+                # shipped scales stamp over the payload rows (the tail
+                # rows stay zeroed for decode's monotone max-update)
+                idx = np.full(P, self.page_pool.num_pages, np.int32)
+                idx[:len(fresh)] = fresh
+                self._ks, self._vs = self._zero_scales_fn(
+                    self._ks, self._vs, jnp.asarray(idx))
+            self._tier_scatter(list(zip(fresh[:len(pages)], pages)))
+        except Exception:
+            # the slot table does not reference `fresh` yet, so the
+            # lease goes straight back to the pool
+            self.page_pool.free(fresh)
+            raise
+        self._table_host[slot] = np.asarray(fresh, np.int32)
+        self._mapped[slot] = True
+        self._pending[slot] = None
+        self._replay[slot] = None
+        self._base[slot] = len(req.output_tokens)
+        self._lengths[slot] = length
+        self._cur_tok[slot] = int(kvp["cur_tok"])
+        self._remaining[slot] = int(kvp["remaining"])
+        self._counters[slot] = int(kvp["counters"])
+        self._seeds[slot] = req.seed
+        self._temp[slot] = req.temperature
+        self._top_k[slot] = req.top_k
+        self._top_p[slot] = req.top_p
+        self._do_sample[slot] = req.do_sample
+        self._eos[slot] = -1 if req.eos_token_id is None \
+            else req.eos_token_id
+        self._done[slot] = False
+        self._kv_tier[slot] = "cold"
+        if self.speculative:
+            self._hist[slot] = [int(t) for t in req.prompt] \
+                + [int(t) for t in req.output_tokens]
+        req.kv_payload = None
+        req.status = "running"
+        self._sync_slot(slot)
+        # the handoff TTFT phase: export stamp -> payload scattered,
+        # on the shared wall clock. The exporter already closed the
+        # five in-process phases at the first token; this engine owns
+        # only the hop, and publishes it into the phase histogram
+        # directly (the first-token budget publication ran over there).
+        t_exp = kvp.get("t_export")
+        if t_exp is not None and telemetry.request_log.enabled:
+            dur = max(0.0, telemetry.request_trace.now() - float(t_exp))
+            self._phase(req, "handoff", dur)
+            key = ("handoff", "cold")
+            child = self._phase_children.get(key)
+            if child is None:
+                child = self._phase_fam.labels(self._eid, *key)
+                self._phase_children[key] = child
+            child.observe(dur)
+        telemetry.request_log.event(
+            req.id, self._eid, "adopted_payload", slot=slot,
+            pages=len(pages), tokens=len(req.output_tokens))
+        self._set_pool_gauges()
+        return True
+
     # -- admission ---------------------------------------------------------
     @supervised("adapter/page leases taken here are rolled back by "
                 "_on_admit_fault (slot state parked, leases released, "
@@ -2518,6 +2670,17 @@ class ServingEngine:
             self._adapter_of[slot] = req.adapter_id \
                 if req.adapter_id not in (None, 0) else None
             self._aslot[slot] = aslot
+        if req.kv_payload is not None:
+            # cross-process handoff (serving/fleet): scatter the
+            # shipped KV pages and splice straight into decode — no
+            # re-prefill. A payload that cannot land here falls
+            # through to the replay restart below, which reaches the
+            # same tokens by recomputing (`kv_history` rode the wire).
+            if self._adopt_payload(slot, req):
+                return None
+            req.kv_payload = None
+            telemetry.request_log.event(req.id, self._eid,
+                                        "handoff_fallback")
         if req.swap is not None:
             # preempted request: splice straight back into decode from
             # its swapped KV — no prefill. A stale swap (payload

@@ -1,0 +1,30 @@
+"""Cross-process serving fleet: worker processes behind a versioned
+wire protocol, with optional disaggregated prefill/decode roles.
+
+Layers (docs/SERVING.md "Cross-process fleet & disaggregated
+prefill/decode"):
+
+* `wire`     — the versioned migration blob format (the in-process
+               export/adopt contract, serialized byte-for-byte)
+* `worker`   — `FleetWorker`: one ServingEngine behind the serving
+               HTTP frontend plus the /fleet/* control plane; runnable
+               as `python -m mxnet_tpu.serving.fleet.worker`
+* `client`   — `WorkerClient` RPC stubs + the WorkerGone /
+               WorkerRejected failure taxonomy
+* `router`   — `FleetRouter`: rendezvous placement, hedging, health
+               watchdog, SIGKILL failover, prefill->decode handoff
+* `launch`   — subprocess supervision (`spawn_worker`/`spawn_fleet`)
+"""
+from .wire import WIRE_VERSION, WireVersionError, encode_request, \
+    decode_request
+from .client import WorkerClient, WorkerGone, WorkerRejected
+from .worker import FleetWorker, build_engine, warm_engine
+from .router import FleetRouter
+from .launch import WorkerProc, FleetProcs, spawn_worker, spawn_fleet
+
+__all__ = [
+    "WIRE_VERSION", "WireVersionError", "encode_request",
+    "decode_request", "WorkerClient", "WorkerGone", "WorkerRejected",
+    "FleetWorker", "build_engine", "warm_engine", "FleetRouter",
+    "WorkerProc", "FleetProcs", "spawn_worker", "spawn_fleet",
+]

@@ -474,6 +474,11 @@ class ServingFrontend:
     flips to 503 + Retry-After (and the registered /readyz probe flips
     not-ready), open streams drain, then everything closes."""
 
+    #: The listener class — subclasses (serving/fleet/worker.py) swap
+    #: in a server whose handler speaks extra control-plane routes on
+    #: the same port.
+    server_class = _FrontendServer
+
     def __init__(self, backend, port=0, host="127.0.0.1", *,
                  stream_buffer=256, keepalive_s=0.25,
                  step_idle_s=0.01, submit_timeout_s=30.0):
@@ -506,7 +511,7 @@ class ServingFrontend:
         self._loop_thread = threading.Thread(
             target=self._serving_loop,
             name=f"mx-serving-loop:{self._fid}", daemon=True)
-        self._server = _FrontendServer(self, port, host)
+        self._server = self.server_class(self, port, host)
         self._loop_thread.start()
 
     # -- lifecycle ---------------------------------------------------------

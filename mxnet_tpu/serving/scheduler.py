@@ -145,6 +145,14 @@ class Request:
         # rides the Request through export/adopt migration, which is
         # how a mid-stream failover re-attaches the live stream.
         self.stream = None
+        # cross-process KV handoff payload (serving/engine.py
+        # export_handoff / serving/fleet): the request's used KV pages
+        # (codes + int8 scale leaves) and decode-cursor scalars, packed
+        # when a finished prefill ships to a decode worker. _admit
+        # scatters it into fresh pages instead of re-prefilling; a
+        # missing/stale payload falls back to the replay restart, which
+        # is bit-identical anyway. None = nothing in flight.
+        self.kv_payload = None
         # whole-request swap record (serving/engine.py _preempt_slot):
         # while a PREEMPTED request waits in queue, its exclusive KV
         # pages live in the host tier under ("req", id) and this dict

@@ -31,8 +31,10 @@ TTFT phase budget (docs/OBSERVABILITY.md "Phase taxonomy"): `phase()`
 records one of the declared `PHASES` with a measured duration;
 per-trace accumulation makes a request's time-to-first-token decompose
 into queue_wait + prefix_match + host_pagein + prefill_chunks +
-first_decode. Phase names are CLOSED — an undeclared name raises here
-and graftlint's `phases` pass flags the literal statically.
+first_decode (+ handoff when a disaggregated fleet ships the finished
+prefill to a decode worker). Phase names are CLOSED — an undeclared
+name raises here and graftlint's `phases` pass flags the literal
+statically.
 
 Zero dependencies: stdlib only, like the rest of `mx.telemetry`.
 """
@@ -61,8 +63,11 @@ def now():
 #: decomposes into exactly these (docs/OBSERVABILITY.md "Phase
 #: taxonomy"); `RequestTraceLog.phase()` rejects anything else and the
 #: graftlint `phases` pass checks recorded literals statically.
+#: `handoff` is cross-process only: the export->scatter gap when a
+#: finished prefill ships its KV pages to a decode worker
+#: (serving/fleet, docs/SERVING.md "Disaggregated prefill/decode").
 PHASES = ("queue_wait", "prefix_match", "host_pagein",
-          "prefill_chunks", "first_decode")
+          "prefill_chunks", "first_decode", "handoff")
 
 # -- W3C trace-context (traceparent) helpers ----------------------------------
 # Header shape: "00-<32 hex trace-id>-<16 hex span-id>-<2 hex flags>".

@@ -229,7 +229,8 @@ def test_tenancy_rides_through_snapshot():
 # null-adapter bit-identity (the pre-PR engine is the oracle)
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("mode", ["plain", "prefix", "spec"])
+@pytest.mark.parametrize("mode", [
+    "plain", "prefix", pytest.param("spec", marks=pytest.mark.slow)])
 def test_null_adapter_output_bit_identical(mode):
     net, cfg = _tiny()
     kw = {}

@@ -319,6 +319,7 @@ def test_serve_singleton_semantics():
     assert telemetry.get_server() is None
 
 
+@pytest.mark.slow
 def test_concurrent_scrape_during_serving_soak():
     """Scrapers hammer every endpoint while the engine serves: no
     exceptions, no non-200s, no torn JSON/exposition snapshots."""

@@ -316,7 +316,8 @@ def _run_preempt_schedule(net, kv_dtype, host_kv_bytes):
     return {r.id: list(r.output_tokens) for r in (low, a, b)}, eng
 
 
-@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+@pytest.mark.parametrize("kv_dtype", [
+    None, pytest.param("int8", marks=pytest.mark.slow)])
 def test_preempt_resume_bit_identical(kv_dtype):
     net, _ = _tiny()
     low_s, a_s, b_s = _preempt_requests()
@@ -333,7 +334,8 @@ def test_preempt_resume_bit_identical(kv_dtype):
     assert all(k[0] != "req" for k in eng.host_pool.keys())
 
 
-@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+@pytest.mark.parametrize("kv_dtype", [
+    None, pytest.param("int8", marks=pytest.mark.slow)])
 def test_preempt_restart_fallback_bit_identical(kv_dtype):
     """Host tier too small for the swap payload: the victim still
     yields its slot, but restarts through the replay path — and the
@@ -353,6 +355,7 @@ def test_preempt_restart_fallback_bit_identical(kv_dtype):
 # tensor parallelism: page-in lands in the head-sharded layout
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 @pytest.mark.skipif(len(jax.devices()) < 2,
                     reason="needs >= 2 devices (CPU runs need "
                     "XLA_FLAGS=--xla_force_host_platform_device_count=8)")

@@ -138,7 +138,7 @@ def test_phases_enum_matches_runtime():
     from mxnet_tpu import telemetry
     enum = phases.phase_enum(Context(root=ROOT, paths=[_ENUM]))
     assert enum == telemetry.PHASES
-    assert len(enum) == 5
+    assert len(enum) == 6   # +handoff: the cross-process hop (ISSUE 18)
 
 
 def test_phases_pass_silent_without_enum_in_view():

@@ -299,6 +299,7 @@ def test_engine_int8_greedy_tolerance_oracle():
             assert q8[i][0] == int(lg.argmax()), f"prompt {i}"
 
 
+@pytest.mark.slow
 def test_engine_int8_schedule_independent_bit_identity():
     """On a FIXED chunk grid (same chunk_tokens, non-binding prefill
     budget) int8 outputs are independent of co-scheduling: slot count,
@@ -619,6 +620,7 @@ def test_quant_adapter_slab_bytes_drop():
     assert q8.a_scale is not None and q8.b_scale is not None
 
 
+@pytest.mark.slow
 def test_quant_adapter_with_int8_kv_end_to_end():
     """Both quantizations at once — int8 KV pages AND the int8 adapter
     slab — serve cleanly, and on a fixed chunk grid the outputs are
@@ -650,6 +652,7 @@ def test_quant_adapter_with_int8_kv_end_to_end():
 # router: kill mid-decode, quantized outputs migrate bit-identically
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_router_kill_mid_decode_int8_bit_identical():
     """Satellite 1's acceptance: a replica killed mid-decode with
     kv_dtype="int8" migrates its in-flight requests and every output

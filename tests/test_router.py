@@ -236,6 +236,7 @@ def test_router_drain_routes_around_and_rejoin_restores():
 # hedged dispatch
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_hedge_winner_cancels_loser_both_directions():
     # direction 1: the primary replica wedges -> the hedge WINS
     engines = [_engine() for _ in range(2)]
@@ -377,6 +378,7 @@ def test_router_aggregated_retry_after_min_no_double_count():
 # chaos soak: Poisson arrivals, kill + hang + poison across the fleet
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_router_chaos_soak_kill_hang_poison_zero_loss(tmp_path):
     N = 104
     poison = {"c17": "both", "c61": "decode", "c88": "prefill"}

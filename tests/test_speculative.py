@@ -366,6 +366,7 @@ def test_engine_spec_eos_and_budget_inside_accepted_run():
     assert eng.scheduler.num_free == 2
 
 
+@pytest.mark.slow
 def test_engine_spec_sampled_reproducible_across_schedules():
     """Sampled spec-on output depends only on (seed, token index,
     history): admission order and slot count must not change it."""

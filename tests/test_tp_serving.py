@@ -110,7 +110,8 @@ def test_tp_mesh_needs_devices():
 @pytest.mark.parametrize("mode,kw", [
     ("plain", {}),
     ("int8", dict(kv_dtype="int8")),
-    ("speculative", dict(speculative=True, spec_tokens=4)),
+    pytest.param("speculative", dict(speculative=True, spec_tokens=4),
+                 marks=pytest.mark.slow),
 ])
 def test_tp_greedy_bit_identical(mode, kw):
     net, _ = _tiny()
@@ -157,7 +158,8 @@ def test_tp_prefix_cache_bit_identical():
 
 
 @_need4
-@pytest.mark.parametrize("slab_dtype", [None, "int8"])
+@pytest.mark.parametrize("slab_dtype", [
+    None, pytest.param("int8", marks=pytest.mark.slow)])
 def test_tp_adapters_bit_identical(slab_dtype):
     """LoRA under tp: the A slab shards on its U axis, B on its output
     axis (the same head-aligned split as the base weights), and the

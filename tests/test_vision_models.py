@@ -37,7 +37,8 @@ def test_resnet_forward_thumbnail(name, kwargs):
 
 
 @pytest.mark.parametrize("name", [
-    "alexnet", "squeezenet1_1", "mobilenet0_25", "mobilenet_v2_0_25",
+    pytest.param("alexnet", marks=pytest.mark.slow),
+    "squeezenet1_1", "mobilenet0_25", "mobilenet_v2_0_25",
 ])
 def test_zoo_forward_224(name):
     net = vision.get_model(name, classes=7)

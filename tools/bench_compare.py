@@ -50,9 +50,11 @@ _LOWER_BETTER = ("latency", "_ms", "seconds", "bytes", "loss",
                  "eviction", "compiles", "shed", "pending", "makespan",
                  "stall", "disconnect", "reprefill",
                  # TTFT phase budget + SLO burn (ISSUE 17): time spent
-                 # in any phase and error-budget burn both want DOWN
+                 # in any phase and error-budget burn both want DOWN —
+                 # including the cross-process handoff phase (ISSUE 18)
                  "queue_wait", "prefix_match", "pagein",
-                 "prefill_chunks", "first_decode", "burn_rate")
+                 "prefill_chunks", "first_decode", "handoff",
+                 "burn_rate")
 
 # capacity/throughput names where MORE is the win — checked FIRST so a
 # lower-is-better token sharing the name (e.g. `bytes` inside

@@ -214,6 +214,74 @@ def run_http():
     return fe
 
 
+def run_fleet():
+    """A REAL cross-process fleet: prefill + decode worker
+    subprocesses behind the wire protocol, a few streamed requests
+    (every one crossing a prefill->decode handoff) — so the router's
+    fleet_* instruments carry real values in the dump. Returns
+    (router, per-worker /metrics aggregation) — the workers' own
+    telemetry lives in THEIR processes, so it is scraped over HTTP and
+    aggregated by family here, exactly what a fleet scrape config
+    would do."""
+    import numpy as np
+
+    from mxnet_tpu.serving import Request, TokenStream
+    from mxnet_tpu.serving.fleet import (FleetRouter, WorkerClient,
+                                         spawn_fleet)
+
+    spec = {"config": {"vocab_size": 97, "units": 32, "num_layers": 2,
+                       "num_heads": 2, "max_length": 64, "dropout": 0.0,
+                       "attention_dropout": 0.0},
+            "seed": 3, "init_std": 0.05,
+            "engine": {"num_slots": 2, "max_length": 32, "page_size": 8,
+                       "attn_impl": "xla"}}
+    rng = np.random.default_rng(0)
+    agg = {"workers": [], "families": {}}
+    with spawn_fleet(spec, roles=("prefill", "decode")) as procs:
+        router = FleetRouter(procs.urls)
+        reqs = [Request(rng.integers(0, 97, n).tolist(), 5, seed=i,
+                        do_sample=bool(i % 2), request_id=f"fleet-{i}")
+                for i, n in enumerate((4, 9, 6))]
+        for r in reqs:
+            r.stream = TokenStream(capacity=64)
+            router.submit(r)
+        for r in reqs:
+            router.result(r, timeout=120)
+        assert all(r.status == "finished" for r in reqs)
+        # scrape + aggregate each worker's /metrics across its port
+        for wp in procs.workers:
+            c = WorkerClient(wp.url)
+            text = c.metrics_text()
+            stats = c.stats()
+            agg["workers"].append({
+                "url": wp.url, "role": wp.role,
+                "worker_id": stats["worker_id"],
+                "handoffs": stats["handoffs"],
+                "steady_state_compiles":
+                    stats["stats"]["steady_state_compiles"],
+                "samples": sum(1 for ln in text.splitlines()
+                               if ln and not ln.startswith("#")),
+            })
+            seen = set()
+            for ln in text.splitlines():
+                if not ln or ln.startswith("#"):
+                    continue
+                name = ln.split("{", 1)[0].split(" ", 1)[0]
+                try:
+                    val = float(ln.rsplit(" ", 1)[1])
+                except ValueError:
+                    continue
+                fam = agg["families"].setdefault(
+                    name, {"samples": 0, "sum": 0.0, "workers": 0})
+                fam["samples"] += 1
+                fam["sum"] += val
+                if name not in seen:
+                    seen.add(name)
+                    fam["workers"] += 1
+        router.close()
+    return router, agg
+
+
 def run_tenants():
     """A multi-tenant engine: more registered adapters than slab
     slots, three tenants with one pushed past its queue quota — so
@@ -385,6 +453,11 @@ def main():
                     help="also serve a tiny engine over a live HTTP "
                          "frontend (streaming clients + one mid-stream "
                          "hangup) and print the ingress headline")
+    ap.add_argument("--fleet", action="store_true",
+                    help="also run a REAL prefill+decode worker-"
+                         "subprocess fleet, scrape and aggregate "
+                         "/metrics across the worker ports, and print "
+                         "the fleet headline")
     ap.add_argument("--serve", type=int, default=None, metavar="PORT",
                     help="start the live introspection server (0 = any "
                          "free port)")
@@ -403,7 +476,7 @@ def main():
     if args.spans:
         telemetry.enable_jsonl(args.spans)
     eng = spec = shed_eng = router = tenant_eng = frontend = None
-    kv_eng = slo_eng = None
+    kv_eng = slo_eng = fleet_router = fleet_agg = None
     with telemetry.span("dump_telemetry.workloads"):
         if args.workload in ("serving", "both"):
             eng, spec = run_serving()
@@ -419,6 +492,8 @@ def main():
             router = run_router()
         if args.http:
             frontend = run_http()
+        if args.fleet:
+            fleet_router, fleet_agg = run_fleet()
         if args.workload in ("training", "both"):
             run_training()
     telemetry.memory.sample()
@@ -543,6 +618,27 @@ def main():
               f"(cancels issued {s['cancels_issued']}, "
               f"noop {s['cancels_noop']}), "
               f"overflows {s['stream_overflows']}, {tail}")
+    if fleet_agg is not None:
+        # the fleet headline: per-worker scrape summary + the router's
+        # own placement/handoff instruments (fleet_* in the snapshot
+        # above — worker-side counters only exist in their processes,
+        # hence the scrape aggregation)
+        for w in fleet_agg["workers"]:
+            print(f"# fleet worker {w['worker_id']} ({w['role']}) "
+                  f"{w['url']}: {w['samples']} metric samples, "
+                  f"handoffs {w['handoffs']}, "
+                  f"steady compiles {w['steady_state_compiles']}")
+        fams = fleet_agg["families"]
+        ho = telemetry.get("fleet_handoff_seconds")
+        rid = fleet_router._rid
+        hs = ho.labels(rid) if ho is not None else None
+        tail = (f"handoff p50 {hs.percentile(50) * 1e3:.1f} ms"
+                if hs is not None and hs.count else "no handoff samples")
+        print(f"# fleet: {len(fleet_agg['workers'])} workers scraped, "
+              f"{len(fams)} metric families aggregated "
+              f"(e.g. serving_tokens_emitted_total "
+              f"{fams.get('serving_tokens_emitted_total', {}).get('sum', 0):.0f} "
+              f"across the fleet), {tail}")
     if args.cost:
         # the /compilez + /memz headline, human-shaped: where every
         # dispatched program sits on the roofline and where HBM went

@@ -39,12 +39,24 @@ Pass criteria (exit 0 only if ALL hold):
     503 reason="draining" with Retry-After, then shutdown() drains
     and releases the port.
 
+`--fleet` runs the same bar across REAL worker subprocesses
+(docs/SERVING.md "Cross-process fleet & disaggregated prefill/decode"):
+the backend becomes a FleetRouter over `spawn_fleet` workers, the same
+ServingFrontend serves the ingress port, and the replica kill becomes
+a seeded SIGKILL of one worker process mid-decode. The bar does not
+soften — zero lost requests, bit-identical full reads against the
+offline reference (the failover replays across the process boundary),
+structured 429/503, and steady_state_compiles == 0 on every surviving
+worker (read over its own /fleet/stats).
+
 Usage:
     JAX_PLATFORMS=cpu python tools/http_soak.py
     python tools/http_soak.py --requests 96 --seed 3 --kill-after 8
     python tools/http_soak.py --replicas 3 --rate 40 --kill-after 0
     python tools/http_soak.py --hbm-budget-bytes 163840 \
         --host-budget-bytes 4194304   # tiered KV: spill + page-in
+    python tools/http_soak.py --fleet                # real subprocesses
+    python tools/http_soak.py --fleet --kv-dtype int8
 """
 import argparse
 import http.client
@@ -166,6 +178,326 @@ class _Client:
         self.raw = rest
 
 
+def _main_fleet(args):
+    """The --fleet soak: same seeded clients, same verdicts, but the
+    backend is a fleet of REAL worker subprocesses and the chaos is a
+    SIGKILL delivered to one of them mid-decode. Everything the
+    verdict needs from a worker crosses its own HTTP surface
+    (/fleet/stats) — this process never touches a worker's engine."""
+    os.environ.setdefault("MX_ASSERT_OWNERSHIP", "1")
+    from mxnet_tpu.analysis import set_assert_ownership
+    set_assert_ownership(
+        os.environ["MX_ASSERT_OWNERSHIP"] in ("1", "true", "yes"))
+
+    import numpy as np
+
+    from mxnet_tpu.serving import Request, ServingFrontend
+    from mxnet_tpu.serving.fleet import (FleetRouter, WorkerClient,
+                                         spawn_fleet)
+    from mxnet_tpu.serving.fleet.worker import build_engine
+
+    max_len, page, slots, block = 64, 8, 2, 4
+    kv = None if args.kv_dtype == "float32" else args.kv_dtype
+    # ONE spec builds the workers AND the offline reference: the init
+    # seed pins the weights, so bit-identity across the process
+    # boundary is meaningful. int8 gets the same non-binding prefill
+    # budget the in-process soak uses — the chunk grid is part of the
+    # numerics (docs/SERVING.md "Quantized KV pages")
+    spec = {
+        "config": dict(vocab_size=97, units=32, num_layers=2,
+                       num_heads=2, max_length=max_len, dropout=0.0,
+                       attention_dropout=0.0),
+        "seed": 3, "init_std": 0.05,
+        "engine": dict(num_slots=slots, max_length=max_len,
+                       page_size=page, decode_block=block,
+                       attn_impl="xla", max_queue=4, kv_dtype=kv,
+                       prefill_chunk_budget=slots * page if kv
+                       else None),
+    }
+    rng = np.random.default_rng(args.seed)
+    behaviors = []
+    for i in range(args.requests):
+        u = rng.random()
+        behaviors.append("read" if u < 0.5
+                         else "hangup" if u < 0.8 else "slow")
+    bodies, prompts = [], []
+    for i in range(args.requests):
+        prompt = rng.integers(1, spec["config"]["vocab_size"],
+                              int(rng.integers(3, 13))).tolist()
+        prompts.append(prompt)
+        body = {"prompt": prompt,
+                "max_new_tokens": int(rng.integers(6, 17)),
+                "request_id": f"soak-{i}"}
+        if behaviors[i] == "slow":
+            body["stream_buffer"] = 2
+        bodies.append(body)
+    victim_idx = int(rng.integers(0, args.replicas))
+
+    # offline reference: the same spec served by one local fault-free
+    # engine — the bar every fleet stream is judged against. Admission
+    # control stays on the workers; the reference queues everything.
+    _net, _cfg, ref_eng = build_engine(
+        dict(spec, engine=dict(spec["engine"], max_queue=None)))
+    ref_reqs = [Request(p, b["max_new_tokens"], request_id=b["request_id"])
+                for p, b in zip(prompts, bodies)]
+    ref_eng.serve(ref_reqs)
+    reference = {r.id: [int(t) for t in r.output_tokens]
+                 for r in ref_reqs}
+    assert all(r.status == "finished" for r in ref_reqs)
+
+    print(f"# --fleet: spawning {args.replicas} mixed workers "
+          f"(kv_dtype={args.kv_dtype}) ...", file=sys.stderr)
+    procs = spawn_fleet(spec, roles=("mixed",) * args.replicas)
+
+    failures = []
+
+    def check(ok, msg):
+        if not ok:
+            failures.append(msg)
+
+    kill_note = {"fired": False, "tokens_emitted": None,
+                 "active_slots": None}
+
+    def killer():
+        # mid-decode, for real: wait until the seeded victim process
+        # has emitted >= kill-after tokens AND holds an active decode
+        # slot, then SIGKILL it — no goodbye, no flushing
+        c = WorkerClient(procs.workers[victim_idx].url)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                st = c.stats()["stats"]
+            except Exception:         # noqa: BLE001 — transient, retry
+                time.sleep(0.01)
+                continue
+            if st["tokens_emitted"] >= args.kill_after \
+                    and st["slot_occupancy"] > 0:
+                kill_note.update(
+                    fired=True, tokens_emitted=st["tokens_emitted"],
+                    active_slots=st["slot_occupancy"])
+                procs.workers[victim_idx].kill()
+                return
+            time.sleep(0.005)
+
+    router = FleetRouter(procs.urls)
+    clients = []
+    for i, (beh, body) in enumerate(zip(behaviors, bodies)):
+        tp = f"00-{i + 1:032x}-{i + 1:016x}-01"
+        if beh == "read":
+            c = _Client(i, "read", body, traceparent=tp)
+        elif beh == "hangup":
+            c = _Client(i, "hangup", body,
+                        cutoff=int(rng.integers(0, 600)), traceparent=tp)
+        else:
+            c = _Client(i, "slow", body,
+                        stall_s=float(rng.uniform(1.0, 1.6)),
+                        traceparent=tp)
+        clients.append(c)
+    arrivals = np.cumsum(rng.exponential(1.0 / args.rate,
+                                         args.requests))
+
+    # pre-soak free-page baseline per worker: the cross-process leak
+    # bar — after quiesce every survivor must be back at it
+    free_at_warm = {}
+    for w in procs.workers:
+        free_at_warm[w.url] = \
+            WorkerClient(w.url).stats()["stats"]["pool_free_pages"]
+
+    fe = ServingFrontend(router, stream_buffer=args.stream_buffer,
+                         keepalive_s=0.05, step_idle_s=0.005)
+    deaths = failovers = 0
+    try:
+        if args.kill_after > 0:
+            threading.Thread(target=killer, daemon=True,
+                             name="soak-fleet-killer").start()
+        threads = []
+        t0 = time.perf_counter()
+        for arr, c in zip(arrivals, clients):
+            lag = arr - (time.perf_counter() - t0)
+            if lag > 0:
+                time.sleep(lag)
+            t = threading.Thread(target=c.run, args=(fe.host, fe.port),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=600)
+        check(not any(t.is_alive() for t in threads),
+              "client threads still alive after 600s")
+
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if (not router.has_work
+                    and fe.stats["active_streams"] == 0
+                    and fe._cmd_q.empty()):
+                break
+            time.sleep(0.02)
+        soak_s = time.perf_counter() - t0
+
+        # -- graceful drain, at the ingress ------------------------------
+        fe.begin_drain()
+        probe = _Client(-1, "read", {"prompt": [1, 2], "max_new_tokens": 2})
+        probe.run(fe.host, fe.port)
+        err = {}
+        try:
+            err = json.loads(probe.raw.decode())["error"]
+        except Exception:             # noqa: BLE001 — verdict below
+            pass
+        check(probe.status == 503 and err.get("reason") == "draining"
+              and int(probe.headers.get("retry-after", 0)) >= 1,
+              f"drain probe: status={probe.status}, error={err}, "
+              f"retry-after={probe.headers.get('retry-after')!r}")
+
+        # -- verdict -----------------------------------------------------
+        st = fe.stats
+        by_code = dict(st["requests_by_code"])
+        rejected = sum(int(v) for k, v in by_code.items()
+                       if k in ("400", "429", "500", "503"))
+        rejected -= 1                 # the drain probe's 503
+        admitted = args.requests - rejected
+        check(not router.has_work, "router still has work after quiesce")
+        check(not router._live, f"live map leaked: {router._live}")
+        check(st["active_streams"] == 0,
+              f"live streams leaked: {st['active_streams']}")
+
+        deaths = int(router._m["deaths"].value)
+        failovers = int(router._m["failovers"].value)
+        if args.kill_after > 0:
+            check(kill_note["fired"],
+                  "seeded SIGKILL never fired (victim never held an "
+                  "active decode slot past the token threshold)")
+            states = {w["url"]: w["state"]
+                      for w in router.fleet_stats()["workers"]}
+            check(states.get(procs.workers[victim_idx].url) == "down",
+                  f"victim not marked down: {states}")
+            check(deaths >= 1, f"worker deaths observed: {deaths}")
+            check(failovers >= 1,
+                  f"no mid-flight failover despite killing a worker "
+                  f"with {kill_note['active_slots']} active slots")
+
+        # survivors: compile-flat and leak-free, judged over their OWN
+        # control plane — this process cannot reach their engines
+        worker_rows = []
+        for i, w in enumerate(procs.workers):
+            if i == victim_idx and kill_note["fired"]:
+                worker_rows.append({"url": w.url, "role": w.role,
+                                    "state": "killed"})
+                continue
+            s = WorkerClient(w.url).stats()
+            es = s["stats"]
+            worker_rows.append({
+                "url": w.url, "role": w.role, "state": "up",
+                "tokens_emitted": es["tokens_emitted"],
+                "requests_finished": es["requests_finished"],
+                "steady_state_compiles": es["steady_state_compiles"]})
+            check(es["steady_state_compiles"] == 0,
+                  f"worker {w.url} steady_state_compiles = "
+                  f"{es['steady_state_compiles']}")
+            check(es["slot_occupancy"] == 0 and es["queue_depth"] == 0,
+                  f"worker {w.url} not idle after quiesce: "
+                  f"active={es['slot_occupancy']} "
+                  f"queued={es['queue_depth']}")
+            check(es["pool_free_pages"] == free_at_warm[w.url],
+                  f"worker {w.url} leaked KV pages: "
+                  f"{es['pool_free_pages']} free vs "
+                  f"{free_at_warm[w.url]} at warm")
+            check(s["frontend"]["active_streams"] == 0,
+                  f"worker {w.url} leaked worker-side streams")
+
+        # per-client verdicts against the offline reference
+        identical = prefix_ok = overflows_seen = reject_ok = 0
+        for c in clients:
+            check(c.error is None, f"client {c.idx}: {c.error}")
+            if c.error is not None or c.status is None:
+                continue
+            if c.status in (429, 503):
+                try:
+                    e = json.loads(c.raw.decode())["error"]
+                    good = (e.get("type") and e.get("reason")
+                            and "retry_after_s" in e)
+                except Exception:     # noqa: BLE001 — verdict
+                    good = False
+                good = good and int(c.headers.get("retry-after", 0)) >= 1
+                check(good, f"client {c.idx}: {c.status} rejection "
+                            f"missing Retry-After or structured body")
+                reject_ok += int(bool(good))
+                continue
+            if c.status != 200:
+                check(False, f"client {c.idx}: unexpected {c.status}")
+                continue
+            want = c.traceparent.split("-")[1]
+            got_tp = (c.headers.get("traceparent") or "").split("-")
+            check(len(got_tp) == 4 and got_tp[1] == want,
+                  f"client {c.idx}: traceparent not echoed "
+                  f"({c.headers.get('traceparent')!r})")
+            evs = _sse_events(c.raw.decode(errors="replace"))
+            got = _sse_tokens(evs)
+            ref = reference[f"soak-{c.idx}"]
+            if c.behavior == "read":
+                dones = [p for ev, p in evs if ev == "done"]
+                check(len(dones) == 1
+                      and dones[0]["status"] == "finished",
+                      f"client {c.idx}: full read did not finish: "
+                      f"{dones}")
+                check(got == ref,
+                      f"client {c.idx}: stream diverged from offline "
+                      f"reference ({got} != {ref})")
+                identical += int(got == ref)
+            else:
+                check(got == ref[:len(got)],
+                      f"client {c.idx}: partial stream is not a prefix "
+                      f"of the reference")
+                prefix_ok += int(got == ref[:len(got)])
+                overflows_seen += int(any(
+                    ev == "error" and p and p.get("error") == "overflow"
+                    for ev, p in evs))
+        check(st["stream_overflows"] == overflows_seen,
+              f"overflow accounting: counted {st['stream_overflows']}, "
+              f"clients saw {overflows_seen} error events")
+        check(identical > 0,
+              "no fully-read stream survived to judge bit-identity")
+
+        fe.shutdown(timeout=60)
+        check(not fe._loop_thread.is_alive(), "serving loop still alive")
+    finally:
+        fe.close()
+        router.close()
+        procs.close()
+
+    summary = {
+        "mode": "fleet",
+        "requests": args.requests,
+        "replicas": args.replicas,
+        "kv_dtype": args.kv_dtype,
+        "soak_seconds": round(soak_s, 3),
+        "requests_by_code": by_code,
+        "admitted": admitted,
+        "rejected": rejected,
+        "full_streams_bit_identical": identical,
+        "partial_streams_prefix_ok": prefix_ok,
+        "rejections_with_retry_after": reject_ok,
+        "stream_overflows": st["stream_overflows"],
+        "sigkill": {
+            "victim": victim_idx,
+            "fired": kill_note["fired"],
+            "victim_tokens_emitted": kill_note["tokens_emitted"],
+            "victim_active_slots": kill_note["active_slots"],
+            "worker_deaths": deaths,
+            "failovers": failovers,
+        },
+        "workers": worker_rows,
+        "failures": failures,
+        "ok": not failures,
+    }
+    print(json.dumps(summary, indent=1, sort_keys=True))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=1, sort_keys=True)
+    for msg in failures:
+        print(f"FAIL: {msg}", file=sys.stderr)
+    return 0 if not failures else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--requests", type=int, default=48,
@@ -211,9 +543,24 @@ def main(argv=None):
                          "exactness contract — 0 output mismatches vs "
                          "the spill-off reference, no page leaked "
                          "across tiers (cross-tier audit)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the soak across REAL worker subprocesses: "
+                         "a FleetRouter over spawn_fleet workers behind "
+                         "the same ingress frontend, with the seeded "
+                         "kill delivered as a SIGKILL to one worker "
+                         "process mid-decode (--kill-after then means: "
+                         "kill once the victim has emitted that many "
+                         "tokens with a decode in flight)")
     ap.add_argument("--json", default=None,
                     help="also write the summary JSON to this path")
     args = ap.parse_args(argv)
+    if args.fleet:
+        if args.tp > 1 or args.hbm_budget_bytes is not None \
+                or args.host_budget_bytes is not None:
+            ap.error("--fleet does not compose with --tp / "
+                     "--hbm-budget-bytes / --host-budget-bytes "
+                     "(single-process engine knobs)")
+        return _main_fleet(args)
     if (args.tp > 1 and "jax" not in sys.modules
             and "host_platform_device_count"
             not in os.environ.get("XLA_FLAGS", "")):

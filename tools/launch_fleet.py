@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Launch and supervise a serving fleet: worker subprocesses behind
+the wire protocol, each one engine behind HTTP (docs/SERVING.md
+"Cross-process fleet & disaggregated prefill/decode").
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/launch_fleet.py --workers 2
+    python tools/launch_fleet.py --roles prefill,decode
+    python tools/launch_fleet.py --spec spec.json --restart
+    python tools/launch_fleet.py --workers 2 --no-ship-payload
+
+--spec is the worker spec (a JSON file path or inline JSON):
+{"config": GPT2Config kwargs, "seed": ..., "init_std": ...,
+ "engine": ServingEngine kwargs} — every worker gets the SAME spec,
+so the fleet holds bit-identical weights (the failover contract needs
+nothing more than that plus a shared RNG discipline). Without --spec a
+tiny demo GPT-2 is used.
+
+The launcher prints one `WORKER <url> <role> <worker_id> pid=<pid>`
+line per ready worker (warmup included — readiness means the
+steady-state program set is compiled), then supervises: with
+--restart a dead worker is respawned in place (same role, fresh
+port); without it a death is reported and the slot stays down. Ctrl-C
+tears the fleet down.
+
+Exit code 0 on a clean shutdown, 1 if any worker died and --restart
+was not given.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEMO_SPEC = {
+    "config": {"vocab_size": 97, "units": 32, "num_layers": 2,
+               "num_heads": 2, "max_length": 64, "dropout": 0.0,
+               "attention_dropout": 0.0},
+    "seed": 3,
+    "init_std": 0.05,
+    "engine": {"num_slots": 2, "max_length": 32, "page_size": 8,
+               "attn_impl": "xla"},
+}
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--workers", type=int, default=2,
+                    help="number of mixed-role workers (ignored when "
+                         "--roles is given)")
+    ap.add_argument("--roles", default=None,
+                    help="comma-separated roles, e.g. prefill,decode "
+                         "or mixed,mixed,mixed")
+    ap.add_argument("--spec", default=None,
+                    help="worker spec: JSON file path or inline JSON "
+                         "(default: tiny demo GPT-2)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--no-ship-payload", action="store_true",
+                    help="handoff blobs carry kv_history only (replay "
+                         "restart on the decode side)")
+    ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--restart", action="store_true",
+                    help="respawn a worker that dies (same role, fresh "
+                         "port)")
+    ap.add_argument("--poll-s", type=float, default=0.5)
+    ap.add_argument("--ready-timeout-s", type=float, default=600.0)
+    args = ap.parse_args()
+
+    from mxnet_tpu.serving.fleet import spawn_worker
+
+    raw = args.spec
+    if raw is None:
+        spec = DEMO_SPEC
+    else:
+        if os.path.exists(raw):
+            with open(raw, "r", encoding="utf-8") as f:
+                raw = f.read()
+        spec = json.loads(raw)
+    roles = ([r.strip() for r in args.roles.split(",") if r.strip()]
+             if args.roles else ["mixed"] * args.workers)
+    if not roles:
+        ap.error("no workers requested")
+
+    kw = dict(spec=spec, host=args.host,
+              ship_payload=not args.no_ship_payload,
+              warmup=not args.no_warmup,
+              ready_timeout_s=args.ready_timeout_s)
+
+    def up(role):
+        wp = spawn_worker(role=role, **kw)
+        print(f"WORKER {wp.url} {wp.role} {wp.worker_id} pid={wp.pid}",
+              flush=True)
+        return wp
+
+    workers = []
+    try:
+        for role in roles:
+            workers.append(up(role))
+        print(f"FLEET_READY {json.dumps([w.url for w in workers])}",
+              flush=True)
+        while True:
+            time.sleep(args.poll_s)
+            for i, w in enumerate(workers):
+                if w.alive():
+                    continue
+                print(f"WORKER_DOWN {w.url} {w.role} pid={w.pid}",
+                      flush=True)
+                if not args.restart:
+                    return 1
+                workers[i] = up(w.role)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        for w in workers:
+            w.kill()
+        print("FLEET_DOWN", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
