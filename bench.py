@@ -1945,6 +1945,220 @@ def bench_gpt2_serving_quantkv():
     return 0 if ok else 1
 
 
+def bench_gpt2_serving_w8():
+    """w8 weight serving vs fp32 at ONE fixed per-chip HBM budget that
+    covers weights AND pages (docs/SERVING.md "Weight quantization").
+    The budget is sized so the fp32 engine's weight slab is binding —
+    it affords only half its natural page pool — and both engines run
+    `hbm_budget_includes_weights=True`: the ~4x megatron weight-slab
+    shrink (int8 codes + f32 per-out-tile dequant scales vs fp32)
+    becomes real admitted KV pages, i.e. capacity, at identical W and
+    zero steady-state compiles. Accuracy is gated exactly like the
+    int8-KV lane: greedy per-token agreement vs the fp32 engine plus a
+    paired-seed first-token frequency test (total variation). Pass
+    criteria: weight-slab ratio >= 3, admitted-pages ratio >= 1.3, w8
+    goodput >= 0.9x fp32, greedy agreement >= 0.6, frequency TV
+    <= 0.30, zero steady compiles, clean audits, everything finished.
+    vs_baseline is the w8/fp32 goodput ratio."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import GPT2ForCausalLM, gpt2_774m_config
+    from mxnet_tpu.serving import Request, ServingEngine
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8 if on_tpu else 4))
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS",
+                                    32 if on_tpu else 20))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", 0))  # req/s; 0=open
+    n_freq = int(os.environ.get("BENCH_W8_FREQ_SEEDS", 200))
+    cfg = gpt2_774m_config(dtype="bfloat16" if on_tpu else "float32",
+                           dropout=0.0, attention_dropout=0.0)
+    max_len, page = 1024, 64
+    p_lo, p_hi, o_lo, o_hi = 16, 128, 32, 96
+    if not on_tpu:  # CPU smoke config
+        cfg.vocab_size, cfg.units, cfg.hidden_size = 512, 256, 1024
+        cfg.num_layers, cfg.num_heads, cfg.max_length = 2, 4, 128
+        max_len, page = 128, 8
+        p_lo, p_hi, o_lo, o_hi = 2, 12, 4, 12
+
+    net = GPT2ForCausalLM(cfg)
+    net.initialize(mx.init.Normal(0.02))
+    if on_tpu:
+        net.cast("bfloat16")
+
+    # ONE per-chip budget covering weights + pages, sized off the fp32
+    # engine: its weight slab plus HALF its natural page pool — fp32 is
+    # weight-limited, and every byte w8 frees is a page it can admit
+    probe = ServingEngine(net, num_slots=slots, max_length=max_len,
+                          page_size=page)
+    wb_fp = probe.stats["weight_bytes_per_chip"]
+    fp_page_bytes = probe.page_pool.page_bytes
+    pages_per_slot = max_len // page
+    fp_pages = max(pages_per_slot, slots * pages_per_slot // 2)
+    budget = wb_fp + fp_page_bytes * fp_pages
+    del probe
+
+    def mk_requests(n, id0):
+        rng = np.random.default_rng(17)
+        out = []
+        for i in range(n):
+            out.append(Request(
+                rng.integers(0, cfg.vocab_size,
+                             int(rng.integers(p_lo, p_hi + 1))).tolist(),
+                int(rng.integers(o_lo, o_hi + 1)),
+                do_sample=bool(i % 2), temperature=0.8, top_k=40,
+                seed=i, request_id=id0 + i))
+        return out
+
+    def run_config(tag, weight_dtype):
+        eng = ServingEngine(net, num_slots=slots, max_length=max_len,
+                            page_size=page, weight_dtype=weight_dtype,
+                            hbm_budget_bytes=budget,
+                            hbm_budget_includes_weights=True,
+                            chunk_tokens=page,
+                            prefill_chunk_budget=slots * page)
+        eng.serve([Request(list(range(1, page + 1)), 2,
+                           request_id=f"{tag}-warm-greedy")])
+        eng.serve([Request(list(range(1, page + 1)), 2, do_sample=True,
+                           seed=0, request_id=f"{tag}-warm-sampled")])
+        eng.mark_warm()
+        c0 = _engine_compiles(eng._eid)
+        eng.reset_stats()
+
+        reqs = mk_requests(n_requests, id0=1000)
+        rng = np.random.default_rng(13)
+        gaps = rng.exponential(1.0 / rate, n_requests) if rate > 0 \
+            else np.zeros(n_requests)
+        arrivals = np.cumsum(gaps)
+        t0 = time.perf_counter()
+        pending = list(zip(arrivals, reqs))
+        while pending or eng.has_work:
+            now = time.perf_counter() - t0
+            while pending and pending[0][0] <= now:
+                eng.submit(pending.pop(0)[1])
+            if eng.has_work:
+                eng.step()
+            elif pending:
+                time.sleep(min(pending[0][0] - now, 0.01))
+        dt = time.perf_counter() - t0
+
+        fin = [r for r in reqs if r.status == "finished"]
+        tokens = sum(len(r.output_tokens) for r in fin)
+        s = eng.stats
+        return eng, {
+            "weight_dtype": eng.weight_dtype,
+            "weight_bytes_total": s["weight_bytes_total"],
+            "weight_bytes_per_chip": s["weight_bytes_per_chip"],
+            "admitted_pages": eng.page_pool.num_pages,
+            "goodput_tokens_per_sec": round(tokens / dt, 2),
+            "makespan_s": round(dt, 3),
+            "finished": len(fin), "requests": n_requests,
+            "steady_state_compiles": _engine_compiles(eng._eid) - c0,
+            "warm_compiles": c0,
+            "audit_leaks": len(eng.audit_pages()),
+            "outputs": {r.id: (bool(r.do_sample), list(r.output_tokens))
+                        for r in reqs},
+            "device_cost": _device_cost_extras(eng._eid),
+        }
+
+    fp_eng, fp = run_config("fp32", None)
+    w8_eng, w8 = run_config("w8", "int8")
+
+    # the SLAB the tentpole shrinks: the megatron col/row weights —
+    # fp32 bytes vs int8 codes + f32 dequant scales for the same arrays
+    slab_fp = sum(int(q.codes.size) * 4 for q in w8_eng._w8_plan)
+    slab_w8 = sum(int(q.codes.size) + int(q.scale.size) * 4
+                  for q in w8_eng._w8_plan)
+    slab_ratio = round(slab_fp / slab_w8, 3)
+    total_ratio = round(fp["weight_bytes_total"]
+                        / w8["weight_bytes_total"], 3)
+
+    # greedy tolerance oracle: per-token agreement on greedy requests
+    out_f, out_w = fp.pop("outputs"), w8.pop("outputs")
+    agree = total = exact = n_greedy = 0
+    for rid, (sampled, toks_f) in out_f.items():
+        if sampled:
+            continue
+        toks_w = out_w[rid][1]
+        n_greedy += 1
+        exact += int(toks_f == toks_w)
+        agree += sum(int(a == b) for a, b in zip(toks_f, toks_w))
+        total += max(len(toks_f), len(toks_w))
+    agreement = agree / total if total else 0.0
+
+    # paired-seed frequency test: same uniform draws through both
+    # engines, marginals only separate where a draw lands between CDFs
+    freq_prompt = list(range(3, 3 + max(3, p_lo)))
+    counts = {}
+    for tag, eng in (("fp", fp_eng), ("w8", w8_eng)):
+        c = {}
+        for s in range(n_freq):
+            r = Request(freq_prompt, 1, do_sample=True, temperature=1.0,
+                        top_k=8, seed=s, request_id=f"freq-{tag}-{s}")
+            eng.serve([r])
+            t = r.output_tokens[0]
+            c[t] = c.get(t, 0) + 1
+        counts[tag] = c
+    support = set(counts["fp"]) | set(counts["w8"])
+    tv = 0.5 * sum(abs(counts["fp"].get(t, 0) - counts["w8"].get(t, 0))
+                   for t in support) / n_freq
+
+    # the frequency serves ran through the already-warm engines
+    for eng, blk in ((fp_eng, fp), (w8_eng, w8)):
+        blk["steady_state_compiles"] = \
+            _engine_compiles(eng._eid) - blk.pop("warm_compiles")
+        blk["audit_leaks"] = len(eng.audit_pages())
+    pages_ratio = round(w8["admitted_pages"] / fp["admitted_pages"], 3)
+    goodput_ratio = round(w8["goodput_tokens_per_sec"]
+                          / max(fp["goodput_tokens_per_sec"], 1e-9), 3)
+    extras = {
+        "hbm_budget_bytes": budget,
+        "budget_includes_weights": True,
+        "weight_slab_ratio": slab_ratio,
+        "weight_total_ratio": total_ratio,
+        "admitted_pages_ratio": pages_ratio,
+        "greedy_token_agreement": round(agreement, 4),
+        "greedy_exact_sequences": f"{exact}/{n_greedy}",
+        "frequency_tv_distance": round(tv, 4),
+        "frequency_seeds": n_freq,
+        "int8": w8, "float32": fp,
+        "slots": slots,
+        "prompt_lens": f"U[{p_lo},{p_hi}]",
+        "output_lens": f"U[{o_lo},{o_hi}]",
+        "arrivals": "open-loop" if rate == 0 else f"poisson({rate}/s)",
+        "params": cfg.num_params(),
+        "device": str(dev.device_kind),
+        "baseline": "fp32 weights at the SAME hbm_budget_bytes "
+                    "(weight-limited, hbm_budget_includes_weights) on "
+                    "the same stream",
+    }
+    _emit("gpt2_serving_w8_goodput_tokens_per_sec",
+          w8["goodput_tokens_per_sec"], "tokens/sec", goodput_ratio,
+          extras=extras)
+    # gate lanes: weight slab bytes (lower-better by name) and admitted
+    # pages (higher-better by explicit override in bench_compare)
+    _emit("gpt2_serving_w8_weight_bytes", slab_w8, "bytes", slab_ratio,
+          extras={"fp32_weight_slab_bytes": slab_fp,
+                  "ratio_vs_fp32": slab_ratio,
+                  "whole_model_ratio": total_ratio})
+    _emit("gpt2_serving_w8_admitted_pages", w8["admitted_pages"],
+          "pages", pages_ratio,
+          extras={"fp32_admitted_pages": fp["admitted_pages"],
+                  "ratio_vs_fp32": pages_ratio})
+    ok = (slab_ratio >= 3.0
+          and pages_ratio >= 1.3
+          and w8["steady_state_compiles"] == 0
+          and fp["steady_state_compiles"] == 0
+          and not w8["audit_leaks"] and not fp["audit_leaks"]
+          and w8["finished"] == n_requests
+          and fp["finished"] == n_requests
+          and goodput_ratio >= 0.9
+          and agreement >= 0.6
+          and tv <= 0.30)
+    return 0 if ok else 1
+
+
 def bench_gpt2_serving_kvspill():
     """Tiered KV cache A/B at ONE fixed HBM page budget (docs/
     SERVING.md "Tiered KV cache"): a Poisson shared-prefix stream
@@ -3045,6 +3259,9 @@ def main():
     if workload in ("serving_quantkv", "quantkv", "int8_kv",
                     "gpt2_serving_quantkv"):
         return bench_gpt2_serving_quantkv()
+    if workload in ("serving_w8", "w8", "weight_quant",
+                    "gpt2_serving_w8"):
+        return bench_gpt2_serving_w8()
     if workload in ("serving_kvspill", "kvspill", "kv_spill",
                     "gpt2_serving_kvspill"):
         return bench_gpt2_serving_kvspill()

@@ -1,4 +1,4 @@
-"""2-bit gradient compression with error feedback.
+"""Gradient compression with error feedback: 2-bit and blockwise int8.
 
 Reference parity: src/kvstore/gradient_compression.cc — the optional
 2-bit quantizer on dist pushes: values above +threshold quantize to
@@ -6,11 +6,26 @@ Reference parity: src/kvstore/gradient_compression.cc — the optional
 error accumulates in a per-key residual added to the next gradient, so
 small updates are eventually transmitted (error-feedback SGD).
 
+`Int8BlockCompressor` is the EQuARX-style variant (PAPERS.md): the
+gradient is split into fixed-size blocks, each block symmetric-int8
+quantized against its own absmax-derived f32 scale, and the wire
+payload is ONE homogeneous uint8 array — the int8 code bytes followed
+by the per-block f32 scales viewed as bytes — so the kvstore allreduce
+ships a single array whose `.nbytes` IS `wire_bytes(shape)`. Error
+feedback works exactly as in the 2-bit path: the per-key residual
+carries the block quantization error into the next step.
+
+The wire contract shared by every compressor (and pinned by
+tests/test_compression.py): `compress(key, grad)` returns one array,
+`compress(...).nbytes == wire_bytes(grad.shape)`, and the kvstore
+meters exactly `wire_bytes` on its compressed allreduce path.
+
 TPU-native notes: quantize/dequantize run on device (jit-fused); the
-wire format packs 16 2-bit codes per uint32 exactly like the reference's
-kernel, so the communicated payload is 1/16 the gradient size. The
-facade kvstore applies it on its host allreduce path; the long-term home
-is quantized XLA collectives (SURVEY.md §5.8, cf. EQuARX)."""
+2-bit wire format packs 16 2-bit codes per uint32 exactly like the
+reference's kernel (payload 1/16 the gradient size), the int8 format
+is ~1/4 plus 4 B per block. The facade kvstore applies both on its
+host allreduce path; the long-term home is quantized XLA collectives
+(SURVEY.md §5.8, cf. EQuARX)."""
 from __future__ import annotations
 
 import functools
@@ -20,7 +35,7 @@ import jax.numpy as jnp
 
 from .base import MXNetError
 
-__all__ = ["TwoBitCompressor"]
+__all__ = ["TwoBitCompressor", "Int8BlockCompressor"]
 
 
 class TwoBitCompressor:
@@ -81,3 +96,75 @@ class TwoBitCompressor:
         for d in shape:
             n *= d
         return ((n + 15) // 16) * 4
+
+
+class Int8BlockCompressor:
+    """EQuARX-style blockwise int8 compressor (error feedback).
+
+    Each `block`-sized run of the flattened gradient quantizes
+    symmetrically against scale = max(|block|)/127; codes are int8, the
+    per-block scales f32. The wire payload is one uint8 array: the code
+    bytes (padded length) followed by the scale bytes, so a single
+    allgather moves everything and the metered bytes equal
+    `wire_bytes(shape)` by construction."""
+
+    def __init__(self, block=256):
+        b = int(block)
+        if b < 1:
+            raise MXNetError("int8 compression block must be >= 1")
+        self.block = b
+        self._residual = {}
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def _quantize(flat, block):
+        pad = (-flat.shape[0]) % block
+        g = jnp.pad(flat, (0, pad)).reshape(-1, block)
+        scale = jnp.maximum(jnp.max(jnp.abs(g), axis=1), 1e-12) / 127.0
+        codes = jnp.clip(jnp.round(g / scale[:, None]),
+                         -127, 127).astype(jnp.int8)
+        code_bytes = jax.lax.bitcast_convert_type(
+            codes, jnp.uint8).reshape(-1)
+        scale_bytes = jax.lax.bitcast_convert_type(
+            scale.astype(jnp.float32), jnp.uint8).reshape(-1)
+        return jnp.concatenate([code_bytes, scale_bytes])
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnums=(1, 2))
+    def _dequantize_payload(payload, block, n):
+        nb = ((n + block - 1) // block)
+        padded = nb * block
+        codes = jax.lax.bitcast_convert_type(
+            payload[:padded], jnp.int8).reshape(nb, block)
+        scale = jax.lax.bitcast_convert_type(
+            payload[padded:].reshape(nb, 4), jnp.float32).reshape(nb)
+        return (codes.astype(jnp.float32)
+                * scale[:, None]).reshape(-1)[:n]
+
+    def compress(self, key, grad):
+        """grad (any shape, float) → uint8 wire payload (codes then
+        scales). Adds the stored residual first and keeps the new block
+        quantization error for the next call."""
+        flat = grad.reshape(-1).astype(jnp.float32)
+        res = self._residual.get(key)
+        if res is not None:
+            flat = flat + res
+        payload = self._quantize(flat, self.block)
+        deq = self._dequantize_payload(payload, self.block,
+                                       flat.shape[0])
+        self._residual[key] = flat - deq
+        return payload
+
+    def decompress(self, payload, shape, dtype=jnp.float32):
+        n = 1
+        for d in shape:
+            n *= d
+        return self._dequantize_payload(
+            payload, self.block, n).reshape(shape).astype(dtype)
+
+    def wire_bytes(self, shape):
+        n = 1
+        for d in shape:
+            n *= d
+        nb = (n + self.block - 1) // self.block
+        return nb * self.block + nb * 4

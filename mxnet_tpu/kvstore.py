@@ -276,10 +276,17 @@ class KVStore:
 
     def set_gradient_compression(self, compression_params):
         """Parity: kvstore.set_gradient_compression({'type': '2bit',
-        'threshold': t}). Applied on the multi-process reduce path
-        (gradient_compression.TwoBitCompressor — 16x smaller wire
-        payload, error feedback); a single-process store has no wire to
-        compress, so there it only records the setting."""
+        'threshold': t}). Applied on the multi-process reduce path;
+        a single-process store has no wire to compress, so there it
+        only records the setting.
+
+        Two compressors (gradient_compression.py): '2bit' — the
+        reference's threshold quantizer, 16x smaller wire payload —
+        and 'int8' — EQuARX-style blockwise-scaled int8
+        ({'type': 'int8', 'block': n}, ~4x smaller), both with error
+        feedback. The metered allreduce bytes are the compressor's
+        `wire_bytes`, i.e. compressed bytes on the wire, never the
+        logical gradient size."""
         self._compression = dict(compression_params or {})
         if not self._compression:
             self._compressor = None  # explicit disable / no-op
@@ -289,13 +296,19 @@ class KVStore:
                 "compression_params requires a 'type' key (the reference "
                 "rejects it too); use {'type': '2bit', 'threshold': t}")
         ctype = self._compression["type"]
-        if ctype != "2bit":
+        if ctype == "2bit":
+            from .gradient_compression import TwoBitCompressor
+            self._compressor = TwoBitCompressor(
+                float(self._compression.get("threshold", 0.5)))
+        elif ctype == "int8":
+            from .gradient_compression import Int8BlockCompressor
+            self._compressor = Int8BlockCompressor(
+                int(self._compression.get("block", 256)))
+        else:
             raise MXNetError(
                 f"unsupported gradient compression type {ctype!r} "
-                "(the reference and this rebuild support '2bit')")
-        from .gradient_compression import TwoBitCompressor
-        self._compressor = TwoBitCompressor(
-            float(self._compression.get("threshold", 0.5)))
+                "(the reference and this rebuild support '2bit'; this "
+                "rebuild adds 'int8')")
         if self.num_workers == 1:
             warnings.warn(
                 "gradient compression set on a single-process kvstore: "
@@ -352,8 +365,13 @@ class _DistSyncKVStore(KVStore):
             for row in gathered:
                 d = comp.decompress(jnp.asarray(row), arr.shape)
                 total = d if total is None else total + d
+            # meter the compressor's wire contract, not the payload
+            # array's incidental representation: wire_bytes(shape) ==
+            # compress(...).nbytes for every compressor (pinned by
+            # tests/test_compression.py), so the counter reports
+            # compressed bytes-on-wire consistently
             _allreduce_bytes.labels(self._type).inc(
-                int(packed.size * packed.dtype.itemsize))
+                int(comp.wire_bytes(arr.shape)))
             _allreduce_seconds.labels(self._type).observe(
                 _time.perf_counter() - t0)
             return total.astype(arr.dtype)

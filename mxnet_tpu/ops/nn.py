@@ -28,15 +28,72 @@ from .registry import op
 # fully connected / dense
 # ---------------------------------------------------------------------------
 
+# w8 weight serving (ISSUE 19): int8 weight codes with per-out-tile f32
+# dequant scales, fused into the matmul. The registry maps id(codes
+# array) -> (codes, scale): `apply_op` strips NDArray wrappers before the
+# kernel runs, so weight identity — not an attribute — is the only signal
+# that survives into FullyConnected. The serving engine registers its
+# traced code arrays inside the unified body (same trace-time ctx
+# discipline as gpt2's `_adapter_ctx`/`_tp_ctx`) and deregisters in a
+# `finally`; `weight_quant.quantize_dense_weights` registers eager code
+# arrays persistently for vision-model dense layers. Entries hold a
+# strong ref to the codes array so an id() is never recycled while
+# registered.
+_W8_SCALES = {}
+
+
+def register_w8_weight(codes, scale):
+    """Register `scale` as the per-out-tile dequant scales for the int8
+    `codes` array. scale is 1-D f32 with size dividing codes.shape[0];
+    FullyConnected applies it to the matmul OUTPUT (valid because the
+    scale depends only on the out index), so HBM weight traffic stays
+    one byte per element."""
+    _W8_SCALES[id(codes)] = (codes, scale)
+    return codes
+
+
+def deregister_w8_weight(codes):
+    _W8_SCALES.pop(id(codes), None)
+
+
+def _w8_dequant_matmul(x, codes):
+    """x @ codes.T with the registered per-out-tile scales applied as an
+    output epilogue: y[..., o] = (x @ codes.T)[..., o] * scale[o // tile].
+    XLA fuses the int8->f32 convert into the dot's operand read, and the
+    epilogue into the dot's consumer, so the weight slab is read at one
+    byte per element."""
+    entry = _W8_SCALES.get(id(codes))
+    if entry is None:
+        raise MXNetError(
+            "int8 weight reached FullyConnected without registered w8 "
+            "dequant scales (register_w8_weight)")
+    scale = entry[1]
+    acc = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+    y = jnp.matmul(x, codes.astype(acc).T)
+    tile = y.shape[-1] // scale.shape[0]
+    if tile * scale.shape[0] != y.shape[-1]:
+        raise MXNetError(
+            f"w8 scale count {scale.shape[0]} does not divide out dim "
+            f"{y.shape[-1]}")
+    y = jnp.reshape(y, y.shape[:-1] + (scale.shape[0], tile))
+    y = y * scale.astype(acc)[..., None]
+    return jnp.reshape(y, y.shape[:-2] + (scale.shape[0] * tile,))
+
+
 @op("FullyConnected")
 def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
                    flatten=True):
     """Parity: src/operator/nn/fully_connected.cc. weight is (num_hidden, K)
-    as in the reference; lowered to dot_general (MXU)."""
+    as in the reference; lowered to dot_general (MXU). An int8 weight is a
+    w8 code array: the registered per-out-tile scales are applied to the
+    matmul output before the bias (fused dequant, ISSUE 19)."""
     x = data
     if flatten and x.ndim > 2:
         x = jnp.reshape(x, (x.shape[0], -1))
-    y = jnp.matmul(x, weight.T)
+    if weight.dtype == jnp.int8:
+        y = _w8_dequant_matmul(x, weight)
+    else:
+        y = jnp.matmul(x, weight.T)
     if bias is not None and not no_bias:
         y = y + bias
     return y

@@ -15,7 +15,31 @@ from ..base import MXNetError
 from .mesh import PartitionSpec
 
 __all__ = ["ShardingRules", "apply_sharding_rules", "megatron_dense_rules",
-           "serving_tp_rules", "fsdp_rules", "ep_rules"]
+           "serving_tp_rules", "fsdp_rules", "ep_rules",
+           "COL_WEIGHT_PATTERN", "ROW_WEIGHT_PATTERN", "megatron_kind"]
+
+# The megatron column/row weight classifiers, exported so consumers that
+# need to KNOW the split (not just apply a spec) share one source of
+# truth — the serving w8 weight quantizer keys its scale layout off this
+# (column-parallel: per-out-tile scales sharded with the out dim;
+# row-parallel: shard-invariant per-out-tile scales applied before the
+# psum).
+COL_WEIGHT_PATTERN = (r"(query|key|value|qkv|attn_in|ffn?_?1|intermediate"
+                      r"|fc1)\.weight$")
+ROW_WEIGHT_PATTERN = (r"(proj|attn_out|out_proj|ffn?_?2|output|fc2)"
+                      r"\.weight$")
+_COL_WEIGHT_RE = re.compile(COL_WEIGHT_PATTERN)
+_ROW_WEIGHT_RE = re.compile(ROW_WEIGHT_PATTERN)
+
+
+def megatron_kind(name):
+    """'col' / 'row' / None for a parameter path under the megatron dense
+    split (first-match-wins, column checked first like the rules)."""
+    if _COL_WEIGHT_RE.search(name):
+        return "col"
+    if _ROW_WEIGHT_RE.search(name):
+        return "row"
+    return None
 
 
 class ShardingRules:
@@ -70,10 +94,9 @@ def megatron_dense_rules(tp_axis="tp", fsdp_axis=None):
     row = PartitionSpec(fsdp_axis, tp_axis)
     rules = ShardingRules()
     # attention QKV + first FFN layer: column parallel
-    rules.add(r"(query|key|value|qkv|attn_in|ffn?_?1|intermediate|fc1)"
-              r"\.weight$", col)
+    rules.add(COL_WEIGHT_PATTERN, col)
     # attention out-proj + second FFN layer: row parallel
-    rules.add(r"(proj|attn_out|out_proj|ffn?_?2|output|fc2)\.weight$", row)
+    rules.add(ROW_WEIGHT_PATTERN, row)
     # column-parallel biases follow the out dim
     rules.add(r"(query|key|value|qkv|attn_in|ffn?_?1|intermediate|fc1)"
               r"\.bias$", PartitionSpec(tp_axis))

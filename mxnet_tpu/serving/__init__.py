@@ -77,6 +77,9 @@ from .speculative import PromptLookupProposer, verify_tokens  # noqa: F401
 from .policy import SheddingPolicy  # noqa: F401
 from .faults import FaultError, FaultPlan, ReplicaFaultPlan  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
+from .weight_quant import (QuantizedWeight, build_weight_plan,  # noqa: F401
+                           dequantize, quantize_dense_weights,
+                           quantize_weight)
 from .router import ServingRouter  # noqa: F401
 from .frontend import ServingFrontend, TokenStream  # noqa: F401
 
@@ -92,4 +95,6 @@ __all__ = ["Request", "SlotScheduler", "RejectedError", "QueueFullError",
            "FaultPlan",
            "FaultError", "ReplicaFaultPlan",
            "filtered_logits", "sample_tokens", "slot_keys",
-           "verify_tokens"]
+           "verify_tokens",
+           "QuantizedWeight", "build_weight_plan", "dequantize",
+           "quantize_dense_weights", "quantize_weight"]

@@ -102,6 +102,8 @@ from .host_tier import HostPagePool
 from .page_pool import PagePool, PagePoolExhausted
 from .prefix_cache import PrefixCache
 from .sampling import sample_tokens, slot_keys
+from .weight_quant import (build_weight_plan, deregister_w8_weight,
+                           register_w8_weight)
 from .scheduler import (QueueFullError, Request, ShedError,
                         SlotScheduler, TenantQuotaError, _seq_counter)
 from .speculative import PromptLookupProposer, verify_tokens
@@ -279,6 +281,11 @@ def _engine_metrics(eid):
             "tensor-parallel shards the unified dispatch runs across "
             "(head-wise shard_map over the tp mesh axis; 1 = "
             "unsharded)", _E),
+        "weight_quant_enabled": g(
+            "serving_weight_quant_enabled",
+            "1 when the engine serves the megatron col/row dense "
+            "weights as int8 codes with fused per-out-tile dequant "
+            "(weight_dtype=\"int8\"), else 0", _E),
         "kv_spill_pages": c(
             "serving_kv_spill_pages_total",
             "KV pages whose payload moved device -> host RAM "
@@ -339,6 +346,7 @@ def _engine_metrics(eid):
     _tenant_families()
     _ttft_family()
     _ttft_phase_family()
+    _weight_bytes_family()
     return {k: inst.labels(eid) for k, inst in m.items()}
 
 
@@ -369,6 +377,20 @@ def _ttft_phase_family():
         "per-request TTFT phase durations (label phase=one of "
         "telemetry.PHASES, kv_tier=resident|spilled|cold)",
         ("engine", "phase", "kv_tier"))
+
+
+def _weight_bytes_family():
+    """Served weight bytes split by storage dtype (ISSUE 19): with
+    weight_dtype="int8" the `int8` child is the code slabs and the
+    `float32` child is everything still full-width (embeddings, the
+    tied LM head, norms, biases, the dequant scales); w8-off puts the
+    whole slab under `float32`. The dtype split IS the capacity
+    headline — `bench.py gpt2_serving_w8` gates on the ~4x shrink."""
+    return telemetry.gauge(
+        "serving_weight_bytes",
+        "device bytes of the served weight operands, by storage dtype "
+        "(int8 code slabs vs float32 params + dequant scales)",
+        ("engine", "dtype"))
 
 
 def _shed_family():
@@ -463,7 +485,8 @@ class ServingEngine:
                  retry_backoff_s=0.02, clock=None, adapter_pool=None,
                  tenant_quotas=None, kv_dtype=None,
                  hbm_budget_bytes=None, host_kv_bytes=None, tp=1,
-                 tp_devices=None):
+                 tp_devices=None, weight_dtype=None,
+                 hbm_budget_includes_weights=False):
         self.model = model
         cfg = model.config
         self.num_slots = int(num_slots)
@@ -567,6 +590,77 @@ class ServingEngine:
             self._param_specs = None
             self._placed = None
         self._slab_cache = None
+        # w8 weight serving (docs/SERVING.md "Weight quantization"): the
+        # megatron col/row dense weights are quantized ONCE here to int8
+        # codes with per-out-tile f32 scales (per shard for the column
+        # split, shard-invariant for the row split — see
+        # serving/weight_quant.py). The code arrays ride the SAME
+        # dispatch operand positions and PartitionSpecs the fp32 weights
+        # did, the scales travel as extra operands, and the dequant is
+        # fused into FullyConnected as an output epilogue. Weight
+        # identity stays runtime data: w8 on/off never adds a program
+        # shape axis, and w8-off builds the exact pre-w8 program.
+        if weight_dtype is not None:
+            try:
+                w8_ok = jnp.dtype(weight_dtype) == jnp.int8
+            except TypeError:
+                w8_ok = False
+            if not w8_ok:
+                raise MXNetError(f"weight_dtype {weight_dtype!r} "
+                                 "unsupported (int8 or None)")
+        self._w8 = weight_dtype is not None
+        self.weight_dtype = "int8" if self._w8 \
+            else str(jnp.dtype(dtype or jnp.dtype(cfg.dtype)))
+        self._w8_plan = ()
+        self._w8_codes = {}
+        self._w8_scale_ops = ()
+        if self._w8:
+            plan = build_weight_plan(model.collect_params().items(),
+                                     tp=self._tp, tp_axis=AXIS_TP,
+                                     max_shards=cfg.num_heads)
+            if not plan:
+                raise MXNetError(
+                    "weight_dtype='int8' found no megatron col/row "
+                    "dense weights to quantize on this model")
+            self._w8_plan = tuple(plan)
+            self._w8_codes = {q.index: q.codes for q in plan}
+            if self._mesh is not None:
+                self._w8_scale_ops = tuple(
+                    jax.device_put(
+                        q.scale,
+                        named_sharding(q.scale_spec, mesh=self._mesh))
+                    for q in plan)
+            else:
+                self._w8_scale_ops = tuple(q.scale for q in plan)
+        # byte-denominated weight accounting (feeds the
+        # serving_weight_bytes{dtype} gauges, /statusz, the HBM ledger
+        # and — when hbm_budget_includes_weights — the page budget):
+        # int8 = code slabs, float32 = everything else incl. the dequant
+        # scales; per-chip divides sharded arrays by tp.
+        wb_int8 = wb_fp = wb_chip = 0
+        w8_by_idx = {q.index: q for q in self._w8_plan}
+        for i, p in enumerate(self._params):
+            d = p.data()._data
+            spec = self._param_specs[i] if self._param_specs else None
+            div = self._tp if (spec is not None
+                               and any(a is not None for a in spec)) \
+                else 1
+            q = w8_by_idx.get(i)
+            if q is not None:
+                cb = int(q.codes.size)          # 1 B/element
+                sb = int(q.scale.size) * 4
+                s_div = self._tp if any(a is not None
+                                        for a in q.scale_spec) else 1
+                wb_int8 += cb
+                wb_fp += sb
+                wb_chip += cb // div + sb // s_div
+            else:
+                nb = int(d.size) * jnp.dtype(d.dtype).itemsize
+                wb_fp += nb
+                wb_chip += nb // div
+        self._weight_bytes = {"int8": int(wb_int8),
+                              "float32": int(wb_fp)}
+        self._weight_bytes_per_chip = int(wb_chip)
         B = self.num_slots
         P = self._pages_per_slot = max_length // page_size
         # pool sizing: every slot can always claim a full P exclusive
@@ -604,12 +698,26 @@ class ServingEngine:
             page_bytes += 2 * L * H * 4    # f32 scales ride each page
         self._hbm_budget = None if hbm_budget_bytes is None \
             else int(hbm_budget_bytes)
+        self._hbm_includes_weights = bool(hbm_budget_includes_weights)
         if self._hbm_budget is not None:
             # under tp each CHIP holds 1/tp of every page (the head
             # axis shards), so the budget — the quantity that actually
             # OOMs — is per chip and buys tp x the pages
+            page_budget = self._hbm_budget
+            if self._hbm_includes_weights:
+                # the served weight slab comes out of the same per-chip
+                # HBM the pages do: charging it here is what turns the
+                # w8 ~4x weight shrink into ADMITTED pages (the
+                # gpt2_serving_w8 bench runs both engines at one fixed
+                # budget where fp32 weights are the binding constraint)
+                page_budget -= self._weight_bytes_per_chip
+                if page_budget <= 0:
+                    raise MXNetError(
+                        f"hbm_budget_bytes {self._hbm_budget} is below "
+                        f"the {self._weight_bytes_per_chip} B/chip the "
+                        f"{self.weight_dtype} weights alone need")
             chip_page = page_bytes // self._tp
-            afford = self._hbm_budget // chip_page
+            afford = page_budget // chip_page
             if afford < P:
                 raise MXNetError(
                     f"hbm_budget_bytes {self._hbm_budget} affords "
@@ -810,6 +918,7 @@ class ServingEngine:
         self._eid = str(next(_engine_ids))
         self._metrics = _engine_metrics(self._eid)
         self._metrics["num_slots"].set(self.num_slots)
+        self._wbytes_fam = _weight_bytes_family()
         self._set_static_gauges()
         self._shed = _shed_family()
         self._shed_children = {}   # (reason, priority) -> labeled child
@@ -916,6 +1025,13 @@ class ServingEngine:
             "kv_bytes_per_token": float(
                 m["kv_bytes_per_token"].value),
             "tp_shards": int(m["tp_shards"].value),
+            "weight_quant_enabled": int(
+                m["weight_quant_enabled"].value),
+            "weight_bytes_int8": self._weight_bytes["int8"],
+            "weight_bytes_float32": self._weight_bytes["float32"],
+            "weight_bytes_total": (self._weight_bytes["int8"]
+                                   + self._weight_bytes["float32"]),
+            "weight_bytes_per_chip": self._weight_bytes_per_chip,
             "kv_spill_pages": int(m["kv_spill_pages"].value),
             "kv_spill_bytes": int(m["kv_spill_bytes"].value),
             "kv_pagein_pages": int(m["kv_pagein_pages"].value),
@@ -950,6 +1066,9 @@ class ServingEngine:
         self._metrics["kv_page_bytes"].set(pb)
         self._metrics["kv_bytes_per_token"].set(pb / self.page_size)
         self._metrics["tp_shards"].set(self._tp)
+        self._metrics["weight_quant_enabled"].set(int(self._w8))
+        for wd, nb in self._weight_bytes.items():
+            self._wbytes_fam.labels(self._eid, wd).set(nb)
 
     def reset_stats(self):
         """Zero this engine's telemetry children (other engines and the
@@ -1147,7 +1266,13 @@ class ServingEngine:
                 "total_pages": self.page_pool.num_pages,
                 "kv_dtype": self.kv_dtype,
                 "kv_page_bytes": self.page_pool.page_bytes,
+                "weight_dtype": self.weight_dtype,
+                "weight_bytes": dict(self._weight_bytes),
+                "weight_bytes_per_chip": self._weight_bytes_per_chip,
+                "quantized_weights": len(self._w8_plan),
                 "hbm_budget_bytes": self._hbm_budget,
+                "hbm_budget_includes_weights":
+                    self._hbm_includes_weights,
                 "host_kv_bytes": self._host_kv_bytes,
                 "steady_state": self._steady,
                 "adapter_pool": self.adapter_pool is not None,
@@ -1268,11 +1393,29 @@ class ServingEngine:
         kv = [self._kp, self._vp]
         if self._quant:
             kv += [self._ks, self._vs]   # dequant scales live with KV
+        # w8: the slab the engine SERVES is int8 codes + dequant scales
+        # for the quantized weights (plus the still-fp32 leftovers); the
+        # model's original fp32 arrays for those weights are a Detail —
+        # retained by the owning net, not part of the serving deployment
+        if self._w8:
+            weights = [self._w8_codes[i] if i in self._w8_codes
+                       else p.data()
+                       for i, p in enumerate(self._params)]
+            weights += list(self._w8_scale_ops)
+        else:
+            weights = [p.data() for p in self._params]
         out = {
-            "weights": [p.data() for p in self._params],
+            "weights": weights,
             "kv_pages": kv,
             "slot_state": list(self._dstate) + [self._d_lock],
         }
+        if self._w8:
+            shadow = sum(
+                int(p.data()._data.size
+                    * jnp.dtype(p.data()._data.dtype).itemsize)
+                for i, p in enumerate(self._params)
+                if i in self._w8_codes)
+            out["weights_fp32_shadow"] = _ledger.Detail(shadow)
         pool = self.adapter_pool
         if pool is not None:
             slab = [pool.A, pool.B, pool.scale]
@@ -2837,8 +2980,18 @@ class ServingEngine:
         per array (cached by identity, the source pinned so ids can't
         be recycled): qkv/fc1 column-sharded, proj/fc2 row-sharded,
         embeddings and norms replicated. set_data swaps the underlying
-        array and therefore re-places."""
-        datas = tuple(p.data()._data for p in self._params)
+        array and therefore re-places. With weight_dtype="int8" the
+        quantized positions carry the int8 CODE arrays instead of the
+        fp32 weights — same positions, same specs, stable identities
+        (quantized once at construction), so the jit cache and the
+        placement cache behave exactly as in the fp path."""
+        if self._w8:
+            datas = tuple(
+                self._w8_codes[i] if i in self._w8_codes
+                else p.data()._data
+                for i, p in enumerate(self._params))
+        else:
+            datas = tuple(p.data()._data for p in self._params)
         if self._mesh is None:
             return datas
         placed = []
@@ -2887,6 +3040,8 @@ class ServingEngine:
                     else f"unified/W{self._width}/{variant}")
             if self._tp > 1:
                 name += f"/tp{self._tp}"
+            if self._w8:
+                name += "/w8"
             fn = self._wrap_program(self._build_unified(greedy_only),
                                     name)
             self._programs[greedy_only] = fn
@@ -2906,6 +3061,14 @@ class ServingEngine:
         S = self.spec_tokens
         quant = self._quant
         tp = self._tp
+        # w8: positions whose param_arrays entry is an int8 code array;
+        # the per-out-tile dequant scales arrive as the operands right
+        # after the KV scale pools and are bound to the traced code
+        # arrays by identity (ops.nn registry) for the duration of the
+        # trace — the same trace-time ctx discipline as the adapter/tp
+        # contexts above, because apply_op strips NDArray attributes
+        # before FullyConnected runs
+        w8_idx = tuple(q.index for q in self._w8_plan)
 
         def unified(param_arrays, kp, vp, table, lock, lengths, cur_tok,
                     done, remaining, counters, seeds, temp, top_k,
@@ -2915,6 +3078,10 @@ class ServingEngine:
                 drafts, n_draft, *rest = rest
             if quant:
                 ks, vs, *rest = rest
+            wscales = ()
+            if w8_idx:
+                wscales = tuple(rest[:len(w8_idx)])
+                rest = rest[len(w8_idx):]
             adapter = tuple(rest)
             saved = [p._data for p in params]
             _trace_channel.push_frame()
@@ -2932,6 +3099,8 @@ class ServingEngine:
                     arr = NDArray(d)
                     arr._grad_req = "null"
                     p._data = arr
+                for i, s in zip(w8_idx, wscales):
+                    register_w8_weight(param_arrays[i], s)
                 active = decode_mask & (~done) & (remaining > 0)
                 prefilling = chunk_len > 0
                 finishing = prefilling & is_final
@@ -3028,6 +3197,8 @@ class ServingEngine:
                 new_cur = jnp.where(emit, last, cur_tok)
                 new_cnt = counters + jnp.where(emit, n_em, 0)
             finally:
+                for i in w8_idx:
+                    deregister_w8_weight(param_arrays[i])
                 if adapter:
                     _set_adapter_ctx(prev_ctx)
                 if tp > 1:
@@ -3066,6 +3237,11 @@ class ServingEngine:
             in_specs += [rep, rep]            # drafts, n_draft
         if quant:
             in_specs += [self._scale_pspec()] * 2
+        # w8 dequant scales: column-parallel scales shard with the out
+        # dim they describe, row-parallel scales are replicated (see
+        # serving/weight_quant.py for why row scales are shard-
+        # invariant); read-only, so never donated
+        in_specs += [q.scale_spec for q in self._w8_plan]
         if self.adapter_pool is not None:
             in_specs += [rep,                  # aslot
                          PartitionSpec(None, None, None, AXIS_TP,
@@ -3159,6 +3335,8 @@ class ServingEngine:
             if spec else ()
         if self._quant:
             extra = extra + (self._ks, self._vs)
+        if self._w8:
+            extra = extra + self._w8_scale_ops
         t0 = self._clock()
         with span("serving.dispatch", engine=self._eid,
                   active=len(active_slots),

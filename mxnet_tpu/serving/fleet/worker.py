@@ -490,6 +490,7 @@ class FleetWorker(ServingFrontend):
                 "max_length": eng.max_length,
                 "num_slots": eng.num_slots,
                 "kv_dtype": eng.kv_dtype,
+                "weight_dtype": eng.weight_dtype,
             },
             "stats": dict(eng.stats,
                           steady_state_compiles=self._steady_compiles),

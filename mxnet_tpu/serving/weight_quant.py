@@ -1,0 +1,187 @@
+"""w8 weight serving: int8 weight codes on the sharded megatron split
+(ISSUE 19).
+
+`ServingEngine(weight_dtype="int8")` quantizes the megatron col/row
+dense weights ONCE at construction — symmetric int8 with per-out-tile
+f32 scales — and serves from the code arrays: the codes ride the same
+dispatch operand positions (and the same PartitionSpecs) the fp32
+weights did, the scales travel as extra replicated-or-sharded operands,
+and the dequant is fused into the matmul as an output epilogue inside
+`ops.nn.FullyConnected` (see `register_w8_weight` there). Everything
+else — embeddings, the tied LM head, norms, biases — stays fp32.
+
+Scale layout on the tp mesh:
+
+- **column-parallel** (qkv / fc1, out-dim sharded): the default out
+  tile divides the per-shard out dim at the FINEST legal split — the
+  head count (`max_shards`), since tp must divide num_heads — so every
+  tile lives inside one shard for EVERY shard count and the codes and
+  scales are byte-identical across tp. The (n_tiles,) scale vector
+  shards with the weight (`PartitionSpec(AXIS_TP)`) — literally
+  per-(layer, shard, out-tile) scales, each shard's slice quantized
+  against only its own rows.
+- **row-parallel** (proj / fc2, in-dim sharded): scales are computed
+  over the FULL in dim and replicated. Each shard applies its scales
+  to its partial product BEFORE the psum (the scale depends only on
+  the out index, so scaling the partials equals scaling the sum) —
+  the per-shard dequant stays inside the one-psum-per-projection
+  discipline. Shard-LOCAL row scales would make the served numerics a
+  function of the shard count; shard-invariant scales keep the PR 15
+  contract that greedy token streams are bit-identical tp=1 vs tp=N.
+
+The quantized weights are pure construction-time data: no monotone
+scale updates, no write schedules — w8 outputs are a deterministic
+function of the tokens, unlike int8 KV pages (docs/SERVING.md "Weight
+quantization").
+"""
+from __future__ import annotations
+
+import re
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..ops.nn import deregister_w8_weight, register_w8_weight
+from ..parallel.mesh import PartitionSpec
+from ..parallel.rules import megatron_kind
+
+__all__ = ["QuantizedWeight", "pick_out_tile", "quantize_weight",
+           "build_weight_plan", "dequantize", "quantize_dense_weights",
+           "register_w8_weight", "deregister_w8_weight"]
+
+# per-out-tile scale granularity cap: tiles are the largest divisor of
+# the (per-shard) out dim <= this. 128 matches the MXU lane width, so
+# the epilogue multiply broadcasts along full vector registers.
+DEFAULT_TILE_CAP = 128
+
+
+class QuantizedWeight(NamedTuple):
+    """One quantized serving weight: `codes` replaces the fp32 array at
+    the weight's dispatch operand position (same PartitionSpec), `scale`
+    travels as an extra operand with `scale_spec`."""
+    index: int              # position in the engine's param list
+    name: str               # parameter path
+    kind: str               # 'col' | 'row' (megatron split)
+    codes: object           # int8 (out, in)
+    scale: object           # f32 (out // tile,)
+    tile: int               # out rows per scale entry
+    scale_spec: object      # PartitionSpec for the scale operand
+
+
+def pick_out_tile(n, cap=DEFAULT_TILE_CAP):
+    """Largest divisor of `n` that is <= cap (>= 1)."""
+    for d in range(min(int(n), int(cap)), 0, -1):
+        if n % d == 0:
+            return d
+    return 1
+
+
+def quantize_weight(w, kind, tp=1, tp_axis=None, tile=None,
+                    max_shards=None):
+    """Symmetric int8 quantization of a (out, in) dense weight with
+    per-out-tile f32 scales. Returns a (codes, scale, tile, scale_spec)
+    tuple; see the module docstring for the col/row layout contract.
+
+    `max_shards` (column-parallel only) is the finest shard count the
+    serving mesh could legally run — the engine passes num_heads — and
+    pins the DEFAULT tile to divide out_dim // max_shards, so the
+    quantization is a pure function of the weights, independent of the
+    tp this engine happens to use (greedy streams stay bit-identical
+    tp=1 vs tp=N, the PR 15 contract)."""
+    w = jnp.asarray(w, jnp.float32)
+    if w.ndim != 2:
+        raise MXNetError(f"w8 quantizes 2-D dense weights, got {w.shape}")
+    out_dim = int(w.shape[0])
+    if kind == "col":
+        shards = int(max_shards or tp)
+        if shards % tp or out_dim % shards:
+            raise MXNetError(
+                f"column-parallel out dim {out_dim} / max_shards "
+                f"{shards} not compatible with tp={tp}")
+        tile = int(tile) if tile else pick_out_tile(out_dim // shards)
+        if (out_dim // tp) % tile:
+            raise MXNetError(
+                f"out tile {tile} does not divide per-shard out dim "
+                f"{out_dim // tp}")
+        scale_spec = PartitionSpec(tp_axis) if tp > 1 else PartitionSpec()
+    elif kind == "row":
+        tile = int(tile) if tile else pick_out_tile(out_dim)
+        if out_dim % tile:
+            raise MXNetError(
+                f"out tile {tile} does not divide out dim {out_dim}")
+        scale_spec = PartitionSpec()
+    else:
+        raise MXNetError(f"unknown w8 weight kind {kind!r}")
+    n_tiles = out_dim // tile
+    grouped = jnp.reshape(w, (n_tiles, tile, w.shape[1]))
+    amax = jnp.max(jnp.abs(grouped), axis=(1, 2))
+    scale = jnp.maximum(amax, 1e-8).astype(jnp.float32) / 127.0
+    codes = jnp.clip(jnp.round(grouped / scale[:, None, None]),
+                     -127, 127).astype(jnp.int8)
+    return (jnp.reshape(codes, w.shape), scale, tile, scale_spec)
+
+
+def dequantize(q):
+    """Merged dequantized fp32 weight for a QuantizedWeight (or any
+    (codes, scale) pair with the per-out-tile layout) — the oracle the
+    w8 tolerance tests serve against."""
+    codes, scale = q.codes, q.scale
+    c = np.asarray(codes, np.float32)
+    s = np.repeat(np.asarray(scale, np.float32), c.shape[0] // scale.shape[0])
+    return c * s[:, None]
+
+
+def build_weight_plan(named_params, tp=1, tp_axis=None, tile=None,
+                      max_shards=None):
+    """Classify and quantize a model's serving weights.
+
+    named_params: iterable of (name, Parameter) in the engine's param
+    order. Every 2-D weight matching the megatron column/row split
+    (parallel.rules.COL/ROW_WEIGHT_PATTERN) is quantized; embeddings,
+    norms and biases are left untouched. `max_shards` pins the col tile
+    to the finest legal split (see quantize_weight). Returns a list of
+    QuantizedWeight entries (possibly empty)."""
+    plan = []
+    for index, (name, p) in enumerate(named_params):
+        kind = megatron_kind(name)
+        if kind is None:
+            continue
+        d = p.data()._data
+        if d.ndim != 2:
+            continue
+        codes, scale, t, spec = quantize_weight(
+            d, kind, tp=tp, tp_axis=tp_axis, tile=tile,
+            max_shards=max_shards)
+        plan.append(QuantizedWeight(index, name, kind, codes, scale, t,
+                                    spec))
+    return plan
+
+
+def quantize_dense_weights(block, pattern=r"\.weight$", tile=None,
+                           cap=DEFAULT_TILE_CAP):
+    """Eager w8 for non-engine models (vision classifier heads etc.):
+    quantize every matching 2-D Dense weight of `block` IN PLACE to int8
+    codes and register persistent fused-dequant scales, so a plain
+    forward runs the same one-byte-per-element weight read the serving
+    engine uses. The block becomes inference-only (grad_req is forced to
+    'null' on converted weights). Returns [(name, QuantizedWeight)]."""
+    pat = re.compile(pattern)
+    done = []
+    for index, (name, p) in enumerate(block.collect_params().items()):
+        if not pat.search(name) or p.shape is None or len(p.shape) != 2:
+            continue
+        d = p.data()._data
+        codes, scale, t, spec = quantize_weight(
+            d, megatron_kind(name) or "row", tile=tile or pick_out_tile(
+                int(d.shape[0]), cap))
+        register_w8_weight(codes, scale)
+        arr = NDArray(codes)
+        arr._grad_req = "null"
+        p._grad_req = "null"
+        p._data = arr
+        done.append((name, QuantizedWeight(index, name, "row", codes,
+                                           scale, t, spec)))
+    return done

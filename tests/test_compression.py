@@ -1,5 +1,9 @@
-"""2-bit gradient compression tests (parity:
-src/kvstore/gradient_compression.cc semantics)."""
+"""Gradient compression tests: the reference's 2-bit threshold
+quantizer (parity: src/kvstore/gradient_compression.cc) and the
+EQuARX-style blockwise int8 compressor (ISSUE 19), plus the wire
+contract both share: `compress(...).nbytes == wire_bytes(shape)` and
+the kvstore allreduce meters exactly wire_bytes — compressed bytes on
+the wire, never the logical gradient size."""
 import numpy as np
 import pytest
 
@@ -7,7 +11,8 @@ import jax.numpy as jnp
 
 import mxnet_tpu as mx
 from mxnet_tpu.base import MXNetError
-from mxnet_tpu.gradient_compression import TwoBitCompressor
+from mxnet_tpu.gradient_compression import (Int8BlockCompressor,
+                                            TwoBitCompressor)
 
 
 def test_quantize_roundtrip_and_wire_size():
@@ -53,3 +58,95 @@ def test_odd_sizes_pad_correctly():
     want = np.where(np.linspace(-1, 1, 37) >= 0.25, 0.25,
                     np.where(np.linspace(-1, 1, 37) <= -0.25, -0.25, 0.0))
     np.testing.assert_allclose(deq, want)
+
+
+# ---------------------------------------------------------------------------
+# EQuARX-style blockwise int8 (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+def test_int8_roundtrip_within_block_scale_bound():
+    """Tolerance oracle: every dequantized value is within half the
+    owning block's quantization step of the input (plus residual=0 on
+    the first call), and the payload is one uint8 array."""
+    c = Int8BlockCompressor(block=32)
+    g = np.random.default_rng(0).standard_normal(100).astype(np.float32)
+    payload = c.compress("k", jnp.asarray(g))
+    assert payload.dtype == jnp.uint8
+    assert int(payload.nbytes) == c.wire_bytes(g.shape)
+    deq = np.asarray(c.decompress(payload, g.shape))
+    gb = np.pad(g, (0, 28)).reshape(-1, 32)
+    scale = np.maximum(np.abs(gb).max(axis=1), 1e-12) / 127.0
+    bound = np.repeat(scale, 32)[:100]
+    assert (np.abs(deq - g) <= bound / 2 + 1e-7).all()
+
+
+def test_int8_error_feedback_transmits_residual():
+    """The block quantization error rides the per-key residual into
+    the next step, so the transmitted total tracks the true signal."""
+    c = Int8BlockCompressor(block=16)
+    g = jnp.full((16,), 0.3, jnp.float32)
+    sent = np.zeros(16, np.float32)
+    for _ in range(10):
+        payload = c.compress("w", g)
+        sent += np.asarray(c.decompress(payload, g.shape))
+    np.testing.assert_allclose(sent, 3.0, atol=0.05)
+
+
+def test_int8_validates_and_kvstore_accepts():
+    with pytest.raises(MXNetError):
+        Int8BlockCompressor(block=0)
+    store = mx.kv.create("local")
+    with pytest.warns(UserWarning, match="single-process"):
+        store.set_gradient_compression({"type": "int8", "block": 64})
+    assert isinstance(store._compressor, Int8BlockCompressor)
+    assert store._compressor.block == 64
+
+
+@pytest.mark.parametrize("mk,kw", [
+    (TwoBitCompressor, {"threshold": 0.5}),
+    (Int8BlockCompressor, {"block": 64}),
+])
+def test_wire_bytes_is_payload_nbytes(mk, kw):
+    """The shared wire contract: for every compressor and every shape,
+    the payload's nbytes equal wire_bytes(shape) — what the kvstore
+    meters — and both are well under the logical f32 size."""
+    c = mk(**kw)
+    for n in (16, 37, 64, 333):
+        g = jnp.asarray(np.linspace(-1, 1, n), jnp.float32)
+        p = c.compress(f"k{n}", g)
+        assert int(p.nbytes) == c.wire_bytes(g.shape), n
+        if n >= 64:     # below one block, padding dominates
+            assert c.wire_bytes(g.shape) < n * 4, n
+
+
+@pytest.mark.parametrize("params,expect", [
+    ({"type": "2bit", "threshold": 0.5}, "2bit"),
+    ({"type": "int8", "block": 64}, "int8"),
+])
+def test_dist_allreduce_meters_wire_bytes(monkeypatch, params, expect):
+    """The compressed allreduce path meters wire_bytes — NOT the
+    logical gradient bytes — and the reduced value equals
+    num_workers x dequant(quant(grad)). Two fake processes via a
+    monkeypatched allgather on a dist-shaped store."""
+    from jax.experimental import multihost_utils
+    from mxnet_tpu import kvstore as kvs
+    store = object.__new__(kvs._DistSyncKVStore)
+    kvs.KVStore.__init__(store, "dist_sync")
+    store._rank, store._size = 0, 2
+    monkeypatch.setattr(multihost_utils, "process_allgather",
+                        lambda x: np.stack([np.asarray(x)] * 2))
+    store.set_gradient_compression(params)
+    assert store._compression["type"] == expect
+    g = jnp.asarray(
+        np.random.default_rng(3).standard_normal(200), jnp.float32)
+    before = kvs._allreduce_bytes.labels("dist_sync").value
+    out = store._allreduce(g, key="w")
+    delta = kvs._allreduce_bytes.labels("dist_sync").value - before
+    comp = store._compressor
+    assert delta == comp.wire_bytes(g.shape)
+    assert delta < int(g.size) * 4          # << logical f32 bytes
+    fresh = type(comp)(**{k: v for k, v in params.items() if k != "type"})
+    want = 2 * np.asarray(fresh.decompress(fresh.compress("w", g),
+                                           g.shape))
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6,
+                               atol=1e-6)

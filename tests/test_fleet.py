@@ -308,6 +308,47 @@ def test_fleet_sigkill_mid_decode_bit_identical_int8():
 
 
 @pytest.mark.slow
+def test_fleet_sigkill_mid_decode_bit_identical_w8():
+    """The int8 SIGKILL story with int8 WEIGHTS on: w8 is pure
+    construction-time data, so every worker process re-quantizes the
+    same net to the same bytes — a migrated request finishes on the
+    survivor bit-identical to an uninterrupted w8 run, and the worker
+    advertises weight_dtype through its stats geometry."""
+    spec = dict(_SPEC, engine=dict(_ENGINE, weight_dtype="int8"))
+    prompts = [[3, 1, 4, 1, 5], list(range(11)), [9, 2, 6]]
+    ref = _reference(prompts, 10, weight_dtype="int8")
+    with spawn_fleet(spec, roles=("mixed", "mixed")) as procs:
+        router = FleetRouter(procs.urls)
+        assert all(WorkerClient(u).stats()["engine"]["weight_dtype"]
+                   == "int8" for u in procs.urls)
+        reqs = [_mk(p, 10, request_id=f"w{i}", seed=i,
+                    do_sample=bool(i % 2))
+                for i, p in enumerate(prompts)]
+        for r in reqs:
+            r.stream = TokenStream(capacity=64)
+            router.submit(r)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if all(len(r.output_tokens) >= 2 for r in reqs):
+                break
+            time.sleep(0.02)
+        assert all(len(r.output_tokens) >= 2 for r in reqs), \
+            [(r.id, len(r.output_tokens)) for r in reqs]
+        victim, survivor = procs.workers
+        victim.kill()
+        for r in reqs:
+            router.result(r, timeout=120)
+        for i, r in enumerate(reqs):
+            assert r.status == "finished", (r.id, r.status)
+            assert list(r.output_tokens) == ref[i], (
+                r.id, r.output_tokens, ref[i])
+        states = {w["url"]: w["state"]
+                  for w in router.fleet_stats()["workers"]}
+        assert states[victim.url] == "down"
+        router.close()
+
+
+@pytest.mark.slow
 def test_fleet_disagg_subprocess_with_and_without_payload():
     """Disaggregated prefill/decode across real processes: handoff
     WITH KV-page payload and the --no-ship-payload replay fallback

@@ -54,7 +54,11 @@ _LOWER_BETTER = ("latency", "_ms", "seconds", "bytes", "loss",
                  # including the cross-process handoff phase (ISSUE 18)
                  "queue_wait", "prefix_match", "pagein",
                  "prefill_chunks", "first_decode", "handoff",
-                 "burn_rate")
+                 "burn_rate",
+                 # w8 weight serving (ISSUE 19): the served weight slab
+                 # ("bytes" already covers gpt2_serving_w8_weight_bytes)
+                 # and the frequency-test drift both want DOWN
+                 "tv_distance")
 
 # capacity/throughput names where MORE is the win — checked FIRST so a
 # lower-is-better token sharing the name (e.g. `bytes` inside

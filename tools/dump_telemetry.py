@@ -51,8 +51,11 @@ def run_serving():
     net = GPT2ForCausalLM(cfg)
     mx.rng.seed(0)
     net.initialize(mx.init.Normal(0.05))
+    # w8 weights on the demo engine so the --cost weight headline and
+    # the serving_weight_bytes gauges carry real quantized values
     eng = ServingEngine(net, num_slots=2, max_length=32, page_size=8,
-                        decode_block=2, attn_impl="xla", prefix_cache=True)
+                        decode_block=2, attn_impl="xla", prefix_cache=True,
+                        weight_dtype="int8")
     rng = np.random.default_rng(0)
     # half the prompts extend one shared prefix so the prefix-cache
     # instruments carry real values in the dump
@@ -684,6 +687,27 @@ def main():
                       "of the FLOPs above; tokens/sec/chip divides "
                       "goodput by the shard count (docs/SERVING.md "
                       '"Tensor-parallel serving")')
+            # the other capacity headline: the served weight slab (w8
+            # moves it ~4x) and what each decode step reads per chip
+            per_tok = (s["weight_bytes_per_chip"]
+                       / max(eng.num_slots, 1))
+            print(f"# weight cost: "
+                  f"{s['weight_bytes_total'] / 1e6:.2f} MB served "
+                  f"(int8 {s['weight_bytes_int8'] / 1e6:.2f} MB + "
+                  f"fp32 {s['weight_bytes_float32'] / 1e6:.2f} MB), "
+                  f"w8 {'on' if s['weight_quant_enabled'] else 'off'}, "
+                  f"{s['weight_bytes_per_chip'] / 1e6:.2f} MB/chip "
+                  f"weight reads per dispatch "
+                  f"(~{per_tok / 1e3:.1f} KB/token at full batch)")
+            if s["weight_quant_enabled"]:
+                slab_fp = sum(int(q.codes.size) * 4
+                              for q in eng._w8_plan)
+                slab_w8 = sum(int(q.codes.size) + int(q.scale.size) * 4
+                              for q in eng._w8_plan)
+                print(f"#   w8 slab: {slab_w8 / 1e6:.2f} MB codes+scales"
+                      f" vs {slab_fp / 1e6:.2f} MB fp32 "
+                      f"({slab_fp / slab_w8:.1f}x smaller — bench.py "
+                      f"gpt2_serving_w8)")
         led = telemetry.ledger.snapshot()
         live = led.get("live_array_bytes")
         unattr = led.get("unattributed_bytes")
