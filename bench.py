@@ -2988,7 +2988,16 @@ def bench_gpt2_serving_disagg():
     steady_state_compiles == 0 on every worker in every arm, and a
     handoff phase on every disaggregated request. vs_baseline on the
     headline metric is mixed_ttft_p99 / disagg_ttft_p99 — what
-    splitting the roles costs (or saves) at the tail."""
+    splitting the roles costs (or saves) at the tail.
+
+    A fourth block runs the fleet-observability A/B: one disaggregated
+    fleet, a warmup stream then eight rotated streams with the
+    FleetCollector (scrape/merge + fleet SLO + trace assembly) off/on
+    — the collector must cost the serving path < 2% (best-of
+    peak-window basis, robust to shared-host stalls), with zero greedy
+    mismatches and zero steady-state compiles, and it contributes the
+    `fleet_tokens_per_sec_per_chip` headline at the measured fleet
+    TTFT p99."""
     import threading
 
     import jax
@@ -3119,6 +3128,79 @@ def bench_gpt2_serving_disagg():
         dis_out, disagg = run_arm("dis", ("prefill", "decode"))
         rep_out, replay = run_arm("rep", ("prefill", "decode"),
                                   ship=False)
+
+        # -- fleet-collector A/B (rotated order, best-of basis) ----------
+        # ONE disaggregated fleet; a discarded warmup stream, then
+        # EIGHT rotated streams (FleetCollector off / on, palindrome
+        # order so both conditions sit at the same mean position under
+        # linear machine drift). Each stream is a CLOSED LOOP with a
+        # bounded in-flight window — the fleet stays saturated, so the
+        # measurement is throughput capacity (the number the
+        # collector's scrape/merge loop would actually perturb), while
+        # the window keeps the workers' control plane responsive (an
+        # unbounded burst piles blocking prefill RPCs onto a worker
+        # until its health probes time out and the watchdog
+        # false-positives a death). Per arm the estimator is the PEAK
+        # SUSTAINED WINDOW — the best tokens/sec over any ~48
+        # consecutive completions — then best-of across each
+        # condition's arms: shared-host stalls are one-sided (they
+        # only slow you down) and multi-second, so they poison the
+        # windows they land in and nothing else, while every ~1 s
+        # window still contains a scrape at the default cadence, so
+        # the traced condition cannot dodge the collector's cost. The
+        # gate proves the whole observability plane (metrics merge +
+        # timeline pulls + SLO feed) stays off the serving path within
+        # the 2% budget.
+        ab_order = (False,                                    # warmup
+                    True, False, False, True,
+                    True, False, False, True)
+        n_ab = 6 * n_requests
+        ab_window = 4 * slots
+        ab_peak, ab_out, fleet_view = [], {}, None
+        ab_procs = spawn_fleet(spec, roles=("prefill", "decode"))
+        try:
+            ab_router = FleetRouter(ab_procs.urls)
+            try:
+                for i, instrumented in enumerate(ab_order):
+                    coll = (ab_router.observe(interval_s=1.0)
+                            if instrumented else None)
+                    done, done_t, inflight, idx = [], [], [], 0
+                    while idx < n_ab or inflight:
+                        while idx < n_ab and len(inflight) < ab_window:
+                            p, m = reqs_spec[idx % n_requests]
+                            r = Request(list(p), m,
+                                        request_id=f"ab{i}-{idx}")
+                            r.stream = TokenStream(capacity=2 * max_len)
+                            ab_router.submit(r)
+                            inflight.append(r)
+                            idx += 1
+                        r = inflight.pop(0)
+                        ab_router.result(r, timeout=300)
+                        done.append(r)
+                        done_t.append(time.perf_counter())
+                    toks = [len(r.output_tokens) for r in done]
+                    K = min(48, len(done))
+                    peak = 0.0
+                    for a in range(len(done) - K + 1):
+                        dt = done_t[a + K - 1] - done_t[a]
+                        if dt > 0:
+                            peak = max(peak,
+                                       sum(toks[a + 1:a + K]) / dt)
+                    ab_peak.append(peak)
+                    ab_out[i] = {j: list(r.output_tokens)
+                                 for j, r in enumerate(done)}
+                    if coll is not None:
+                        coll.scrape()
+                        fleet_view = coll.fleetz()
+                        coll.close()
+                        ab_router._collector = None
+                    time.sleep(0.5)     # let a CFS quota bucket refill
+                ab_wstats = [WorkerClient(w.url).stats()
+                             for w in ab_procs.workers]
+            finally:
+                ab_router.close()
+        finally:
+            ab_procs.close()
     finally:
         jax.config.update("jax_default_prng_impl", prng_before)
 
@@ -3167,6 +3249,50 @@ def bench_gpt2_serving_disagg():
           "tokens", 0.0,
           extras={"vs": "2-worker mixed fleet arm",
                   "vs_offline_engine": ref_mismatches})
+
+    # -- the fleet observability plane's own lanes -----------------------
+    # arm 0 is the discarded warmup; best-of peak-window per condition
+    g_plain = max(g for en, g in zip(ab_order[1:], ab_peak[1:])
+                  if not en)
+    g_traced = max(g for en, g in zip(ab_order[1:], ab_peak[1:]) if en)
+    obs_overhead = round(float(g_plain) / max(float(g_traced), 1e-9)
+                         - 1.0, 4)
+    ab_mismatches = sum(ab_out[i][j] != mixed_out[j % n_requests]
+                        for i in ab_out for j in ab_out[i])
+    ab_steady = sum(s["stats"]["steady_state_compiles"]
+                    for s in ab_wstats)
+    fv = (fleet_view or {}).get("fleet", {})
+    chips = max(int(fv.get("chips") or len(ab_wstats)), 1)
+    per_chip = round(float(g_traced) / chips, 1)
+    # headline: fleet tokens/sec/chip the collector-on fleet sustained,
+    # reported AT the fleet-merged TTFT p99 it was achieved at
+    # (higher-better by name for bench_compare); vs_baseline is
+    # traced/plain goodput — what observing the fleet costs the number
+    # it reports
+    _emit("gpt2_serving_disagg_fleet_tokens_per_sec_per_chip", per_chip,
+          "tokens/sec/chip",
+          round(float(g_traced) / max(float(g_plain), 1e-9), 4),
+          extras={"chips": chips,
+                  "at_ttft_p99_ms": fv.get("ttft_p99_ms"),
+                  "fleet_tokens_per_sec": round(float(g_traced), 1),
+                  "collector_gauge_tokens_per_sec_per_chip":
+                      fv.get("tokens_per_sec_per_chip"),
+                  "workers_stale": fv.get("workers_stale"),
+                  "greedy_mismatches_vs_mixed": ab_mismatches,
+                  "steady_state_compiles": ab_steady,
+                  "arms": [round(float(g), 1) for g in ab_peak],
+                  "order": "warmup + collector off/on x4 each, "
+                           "palindrome rotation, best-of peak-window"})
+    # gate lane: the collector must stay off the serving hot path —
+    # additive vs_baseline against the 2% budget
+    _emit("gpt2_serving_disagg_obs_overhead", obs_overhead, "fraction",
+          round(1.0 + obs_overhead, 4),
+          extras={"budget": 0.02,
+                  "goodput_traced": round(float(g_traced), 2),
+                  "goodput_plain": round(float(g_plain), 2),
+                  "scrape_interval_s": 1.0,
+                  "order": "warmup + rotated x4 per arm, best-of "
+                           "peak-window basis"})
     # every prompt crossed the prefill->decode seam in BOTH disagg
     # arms (the prefill worker's handoff counter); the "handoff" TTFT
     # phase exists only where a KV payload was adopted — the replay
@@ -3179,7 +3305,9 @@ def bench_gpt2_serving_disagg():
           and steady == 0
           and disagg["handoff_phase_requests"] == n_requests
           and crossed["disagg"] == n_requests
-          and crossed["replay"] == n_requests)
+          and crossed["replay"] == n_requests
+          and obs_overhead < 0.02
+          and ab_mismatches == 0 and ab_steady == 0)
     return 0 if ok else 1
 
 

@@ -183,6 +183,11 @@ def register_everything():
     telemetry.cost._metrics()                  # cost/compile family
     telemetry.ledger._gauges(telemetry.default_registry)
     telemetry.slo.slo_engine._families()       # slo burn/event family
+    from mxnet_tpu.serving.fleet import router as fleet_router
+    fleet_router._fleet_metrics("catalog-check")
+    from mxnet_tpu.serving.fleet import observe as fleet_observe
+    fleet_observe._fleet_collector_metrics("catalog-check")
+    fleet_observe._fleet_slo_metrics()         # slo_fleet_* family
     with telemetry.span("catalog_check"):      # span_duration_seconds
         pass
     telemetry.flight.install(out_dir="/tmp/mx-catalog-check")

@@ -14,6 +14,12 @@ prefill/decode"):
 * `router`   — `FleetRouter`: rendezvous placement, hedging, health
                watchdog, SIGKILL failover, prefill->decode handoff
 * `launch`   — subprocess supervision (`spawn_worker`/`spawn_fleet`)
+* `observe`  — `FleetCollector`: the fleet observability plane —
+               scrape/merge every worker's metrics (counters summed,
+               gauges per-worker, histograms bucket-wise), assemble
+               one clock-aligned Perfetto trace across processes,
+               judge fleet-global SLOs, latch correlated fleet flight
+               dumps, serve /fleetz
 """
 from .wire import WIRE_VERSION, WireVersionError, encode_request, \
     decode_request
@@ -21,10 +27,12 @@ from .client import WorkerClient, WorkerGone, WorkerRejected
 from .worker import FleetWorker, build_engine, warm_engine
 from .router import FleetRouter
 from .launch import WorkerProc, FleetProcs, spawn_worker, spawn_fleet
+from .observe import FleetCollector, fleet_chrome_trace
 
 __all__ = [
     "WIRE_VERSION", "WireVersionError", "encode_request",
     "decode_request", "WorkerClient", "WorkerGone", "WorkerRejected",
     "FleetWorker", "build_engine", "warm_engine", "FleetRouter",
     "WorkerProc", "FleetProcs", "spawn_worker", "spawn_fleet",
+    "FleetCollector", "fleet_chrome_trace",
 ]

@@ -249,8 +249,22 @@ class WorkerClient:
     def stats(self, timeout=10.0):
         return self._json("GET", "/fleet/stats", timeout=timeout)
 
-    def requests(self, timeout=10.0):
-        return self._json("GET", "/fleet/requests", timeout=timeout)
+    def requests(self, n=None, timeout=10.0):
+        """GET /fleet/requests — the worker's recent request
+        timelines; `n` bounds the pull (the collector caps it so a
+        scrape cycle's cost stays flat as the log fills)."""
+        path = "/fleet/requests" if n is None \
+            else f"/fleet/requests?n={int(n)}"
+        return self._json("GET", path, timeout=timeout)
+
+    def sloz(self, timeout=10.0):
+        """GET /fleet/sloz — the worker's SLO snapshot + clock stamp."""
+        return self._json("GET", "/fleet/sloz", timeout=timeout)
+
+    def flightz(self, timeout=10.0):
+        """GET /fleet/flightz — the worker's flight-recorder state
+        (latched reasons, dump paths, breadcrumb tail)."""
+        return self._json("GET", "/fleet/flightz", timeout=timeout)
 
     def healthz(self, timeout=2.0):
         try:

@@ -169,6 +169,7 @@ class FleetRouter:
         self._m = _fleet_metrics(self._rid)
         self._lock = threading.Lock()
         self._live = {}             # request id -> _Track
+        self._collector = None      # FleetCollector via observe()
         self._closed = False
         self._reps = []
         for i, w in enumerate(workers):
@@ -215,8 +216,29 @@ class FleetRouter:
     def disaggregated(self):
         return self._disagg
 
+    def observe(self, **kw):
+        """Build + start a `FleetCollector` over this router's workers
+        (fleet/observe.py): the scrape-merge loop, the fleet SLO
+        engine, correlated fleet dumps, and the /fleetz payload on
+        this process's introspection server. Keyword args pass through
+        to the collector (interval_s, objectives, out_dir, ...); the
+        router closes it with itself."""
+        if self._collector is not None:
+            return self._collector
+        from .observe import FleetCollector
+        self._collector = FleetCollector(
+            [r.client for r in self._reps], router=self, **kw)
+        return self._collector.start()
+
+    @property
+    def collector(self):
+        return self._collector
+
     def close(self):
         self._closed = True
+        if self._collector is not None:
+            self._collector.close()
+            self._collector = None
         with self._lock:
             live = list(self._live.values())
         for tr in live:

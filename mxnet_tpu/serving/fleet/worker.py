@@ -42,7 +42,7 @@ import os
 import queue
 import threading
 import time
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 from ...base import MXNetError
 from ... import telemetry
@@ -83,7 +83,18 @@ class _WorkerHandler(_Handler):
                 self._reply(self.fw.fleet_stats())
                 return
             if path == "/fleet/requests":
-                self._reply(self.fw.recent_requests())
+                q = parse_qs(urlparse(self.path).query)
+                try:
+                    n = max(1, int(q["n"][0])) if "n" in q else 100
+                except ValueError:
+                    n = 100
+                self._reply(self.fw.recent_requests(n))
+                return
+            if path == "/fleet/sloz":
+                self._reply(self.fw.fleet_sloz())
+                return
+            if path == "/fleet/flightz":
+                self._reply(self.fw.fleet_flightz())
                 return
         except _DISCONNECT_ERRORS:
             return
@@ -477,6 +488,12 @@ class FleetWorker(ServingFrontend):
             "role": self.role,
             "pid": os.getpid(),
             "url": self.url,
+            # THIS process's wall-anchored request-trace clock, sampled
+            # at answer time — the fleet collector brackets the RPC
+            # with its own clock and derives a per-worker offset, so
+            # cross-process trace assembly can align every worker's
+            # timeline onto the collector's axis
+            "now": telemetry.now(),
             "wire_version": wire.WIRE_VERSION,
             "ship_payload": self.ship_payload,
             "draining": self.draining,
@@ -502,8 +519,28 @@ class FleetWorker(ServingFrontend):
         workers share the process-global request log, so the engine id
         scopes the answer."""
         eid = str(self._backend._eid)
-        return [t for t in telemetry.request_log.recent(max(n * 4, 200))
+        return [t for t in telemetry.request_log.recent(max(n * 4, 64))
                 if str(t.get("engine")) == eid][-n:]
+
+    def fleet_sloz(self):
+        """GET /fleet/sloz — this process's SLO engine snapshot plus
+        the clock stamp the collector's alignment needs."""
+        return {"worker_id": self.worker_id, "now": telemetry.now(),
+                "slo": telemetry.slo.snapshot()}
+
+    def fleet_flightz(self):
+        """GET /fleet/flightz — this process's flight-recorder state:
+        latched reasons (the collector mirrors any NEW latch into a
+        correlated fleet dump), completed dump paths, and a bounded
+        tail of the breadcrumb ring."""
+        rec = telemetry.flight.get()
+        out = {"worker_id": self.worker_id, "now": telemetry.now(),
+               "armed": rec is not None,
+               "latched": telemetry.flight.latched_reasons()}
+        if rec is not None:
+            out["dumps"] = [str(p) for p in rec.dumps]
+            out["events_tail"] = rec.events()[-64:]
+        return out
 
 
 # -- spec-driven process entry ---------------------------------------------

@@ -61,7 +61,7 @@ from .tracing import (  # noqa: F401
 from .request_trace import (  # noqa: F401
     RequestTrace, RequestTraceLog, request_log, chrome_trace,
     PHASES, new_trace_id, new_span_id, parse_traceparent,
-    format_traceparent,
+    format_traceparent, now,
 )
 from .server import (  # noqa: F401
     IntrospectionServer, serve, stop_server, get_server,
@@ -84,7 +84,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "Registry",
            "disable_jsonl", "add_event_hook", "remove_event_hook",
            "RequestTrace", "RequestTraceLog", "request_log",
            "chrome_trace", "PHASES", "new_trace_id", "new_span_id",
-           "parse_traceparent", "format_traceparent",
+           "parse_traceparent", "format_traceparent", "now",
            "SLO", "slo_engine", "slo",
            "IntrospectionServer", "serve",
            "stop_server", "get_server", "register_status_provider",

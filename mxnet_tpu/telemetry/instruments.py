@@ -297,6 +297,73 @@ class Histogram(_Instrument):
             cum += c
         return mx
 
+    # -- merging -----------------------------------------------------------
+    def merge(self, other):
+        """Fold `other`'s observations into self, BUCKET-WISE: per-bucket
+        counts add, sum/count add, min/max widen. This is the only
+        correct way to combine histograms from different processes —
+        averaging per-process percentiles is wrong the moment the
+        processes saw different loads (docs/OBSERVABILITY.md "Fleet
+        observability"; tests/test_telemetry.py proves it against a
+        numpy oracle). Requires identical bucket boundaries."""
+        if not isinstance(other, Histogram):
+            raise MXNetError(f"cannot merge {type(other).__name__} into "
+                             f"histogram {self.name!r}")
+        if other.buckets != self.buckets:
+            raise MXNetError(
+                f"histogram merge for {self.name!r} needs identical "
+                f"buckets: {len(self.buckets)} bounds vs "
+                f"{len(other.buckets)}")
+        with other._lock:
+            counts = list(other._counts)
+            o_sum, o_count = other._sum, other._count
+            o_min, o_max = other._min, other._max
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._sum += o_sum
+            self._count += o_count
+            if o_min < self._min:
+                self._min = o_min
+            if o_max > self._max:
+                self._max = o_max
+        return self
+
+    @classmethod
+    def from_cumulative(cls, bounds, cumulative, sum, count,
+                        name="", help=""):
+        """Reconstruct a Histogram from Prometheus exposition samples:
+        `bounds` are the finite `le` bucket bounds (ascending, no +Inf)
+        and `cumulative` the matching cumulative counts PLUS the final
+        +Inf count (len(bounds) + 1 entries). min/max are synthesized
+        from the outermost non-empty buckets — the exposition format
+        does not carry them — so `percentile()` stays exact to one
+        bucket's resolution on the reconstruction."""
+        bounds = tuple(float(b) for b in bounds)
+        if len(cumulative) != len(bounds) + 1:
+            raise MXNetError(
+                f"from_cumulative for {name!r}: {len(bounds)} bounds "
+                f"need {len(bounds) + 1} cumulative counts, got "
+                f"{len(cumulative)}")
+        h = cls(name, help, buckets=bounds)
+        prev = 0
+        for i, cum in enumerate(cumulative):
+            c = int(cum) - prev
+            if c < 0:
+                raise MXNetError(
+                    f"from_cumulative for {name!r}: cumulative counts "
+                    "must be non-decreasing")
+            h._counts[i] = c
+            prev = int(cum)
+        h._count = int(count)
+        h._sum = float(sum)
+        if h._count:
+            nonzero = [i for i, c in enumerate(h._counts) if c]
+            lo_i, hi_i = nonzero[0], nonzero[-1]
+            h._min = bounds[lo_i - 1] if lo_i > 0 else min(0.0, bounds[0])
+            h._max = bounds[hi_i] if hi_i < len(bounds) else bounds[-1]
+        return h
+
     def _reset_self(self):
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0

@@ -36,6 +36,11 @@ introspection server"):
     /sloz        JSON: declared SLO objectives + multi-window burn
                  rates (fast/slow windows, Google-SRE style) and which
                  objectives are currently fast-burning (telemetry.slo)
+    /fleetz      JSON: the fleet collector's view — per-worker health/
+                 role/staleness, fleet tokens/sec and tokens/sec/chip,
+                 the fleet-global SLO snapshot (404 until a
+                 FleetCollector registers via
+                 register_fleetz_provider)
 
 Every read is a snapshot under the instrument locks, so concurrent
 scrapes during serving never tear (tests/test_introspection.py soaks
@@ -60,7 +65,9 @@ __all__ = ["serve", "stop_server", "get_server", "IntrospectionServer",
            "collect_status", "set_degraded", "clear_degraded",
            "degraded_reasons", "register_ready_probe",
            "unregister_ready_probe", "readiness", "component_ready",
-           "healthz_body", "readyz_body"]
+           "healthz_body", "readyz_body",
+           "register_fleetz_provider", "unregister_fleetz_provider",
+           "fleetz_payload"]
 
 _T0 = time.time()
 _providers_lock = threading.Lock()
@@ -183,6 +190,49 @@ def readyz_body(component=None):
     return body, (200 if ready else 503)
 
 
+_fleetz_lock = threading.Lock()
+_fleetz_provider = None    # () -> weakref-able callable () -> dict
+
+
+def register_fleetz_provider(fn):
+    """Publish `fn() -> dict` as the /fleetz payload — the fleet
+    collector registers its `fleetz` bound method here (held weakly,
+    like status providers, so a dead collector drops out). One
+    provider per process: the latest registration wins."""
+    global _fleetz_provider
+    with _fleetz_lock:
+        _fleetz_provider = _weakly(fn)
+
+
+def unregister_fleetz_provider(fn=None):
+    """Drop the /fleetz provider. With `fn` given, only drop it when
+    it is still the registered one (a newer collector's registration
+    survives an older collector's close)."""
+    global _fleetz_provider
+    with _fleetz_lock:
+        if fn is not None and _fleetz_provider is not None \
+                and _fleetz_provider() not in (fn, None):
+            return
+        _fleetz_provider = None
+
+
+def fleetz_payload():
+    """The /fleetz body, or None when no collector is registered (or
+    the registered one has been garbage-collected)."""
+    global _fleetz_provider
+    with _fleetz_lock:
+        get = _fleetz_provider
+    if get is None:
+        return None
+    fn = get()
+    if fn is None:
+        with _fleetz_lock:
+            if _fleetz_provider is get:
+                _fleetz_provider = None
+        return None
+    return fn()
+
+
 def register_status_provider(name, fn):
     """Publish `fn() -> dict` under `name` in /statusz and in flight
     dumps. Bound methods are held via WeakMethod — a dead owner drops
@@ -300,6 +350,9 @@ _INDEX = """<!doctype html><title>mx.telemetry</title>
 <li><a href="/memz">/memz</a> — HBM ledger vs live-array bytes</li>
 <li><a href="/sloz">/sloz</a> — SLO objectives + multi-window
  burn rates</li>
+<li><a href="/fleetz">/fleetz</a> — fleet collector view: per-worker
+ health/staleness, fleet tokens/sec(/chip), fleet SLO (404 until a
+ collector registers)</li>
 <li><a href="/healthz">/healthz</a> — liveness (degraded while a
  flight dump is latched)</li>
 <li><a href="/readyz">/readyz</a> — readiness (warmed &and; not
@@ -363,6 +416,18 @@ class _Handler(BaseHTTPRequestHandler):
                 from . import slo
                 self._reply(json.dumps(slo.snapshot(), indent=1,
                                        sort_keys=True, default=str))
+            elif url.path == "/fleetz":
+                body = fleetz_payload()
+                if body is None:
+                    self._reply(json.dumps(
+                        {"error": "no fleet collector registered in "
+                                  "this process",
+                         "hint": "FleetRouter.observe() or "
+                                 "FleetCollector.start() registers "
+                                 "one"}), code=404)
+                else:
+                    self._reply(json.dumps(body, indent=1,
+                                           sort_keys=True, default=str))
             else:
                 self._reply(json.dumps({"error": "not found",
                                         "path": url.path}), code=404)

@@ -143,15 +143,26 @@ class SLOEngine:
     clock: injectable (engine-style) for tests; default perf_counter.
     The burn-rate math only ever sees THIS clock, so hand-driven
     clocks give exact window arithmetic.
+
+    metrics: optional {"events": Counter, "burn": Gauge, "burning":
+    Gauge} to publish into, replacing the default `slo_*` families —
+    the fleet collector injects literally-declared `slo_fleet_*`
+    instruments so per-process and fleet-wide burn never share a
+    series. on_fast_burn: optional `fn(objective_name, detail)`
+    replacing the default flight-recorder trigger on a fresh fast
+    burn — the fleet engine routes this into the correlated fleet
+    dump instead of the local process's recorder.
     """
 
-    def __init__(self, objectives=(), clock=None):
+    def __init__(self, objectives=(), clock=None, metrics=None,
+                 on_fast_burn=None):
         self._lock = threading.Lock()
         self._clock = clock if clock is not None else time.perf_counter
         self._objectives = []
         self._series = {}          # (name, key) -> _Series
         self._burning = set()      # objective names fast-burning now
-        self._metrics = None
+        self._metrics = dict(metrics) if metrics is not None else None
+        self._on_fast_burn = on_fast_burn
         self.configure(objectives)
 
     # -- setup -------------------------------------------------------------
@@ -203,26 +214,30 @@ class SLOEngine:
         return self._metrics
 
     # -- observation -------------------------------------------------------
-    def observe_ttft(self, ttft_s, priority=None, tenant=None):
+    def observe_ttft(self, ttft_s, priority=None, tenant=None, t=None):
         """Classify one first-token latency against every TTFT
-        objective. No-op (one attribute read) with none configured."""
+        objective. No-op (one attribute read) with none configured.
+        `t` backdates the observation onto the engine's clock axis —
+        the fleet collector stamps aligned event times so its burn
+        windows stay exact under scrape lag."""
         if not self._objectives:
             return
         ms = float(ttft_s) * 1e3
         self._observe("ttft_p99_ms", lambda slo: ms <= slo.ttft_p99_ms,
-                      priority, tenant)
+                      priority, tenant, t)
 
-    def observe_goodput(self, tokens_per_s, priority=None, tenant=None):
+    def observe_goodput(self, tokens_per_s, priority=None, tenant=None,
+                        t=None):
         """Classify one finished request's decode goodput against
         every goodput objective."""
         if not self._objectives:
             return
         rate = float(tokens_per_s)
         self._observe("goodput_min", lambda slo: rate >= slo.goodput_min,
-                      priority, tenant)
+                      priority, tenant, t)
 
-    def _observe(self, field, is_good, priority, tenant):
-        t = self._clock()
+    def _observe(self, field, is_good, priority, tenant, t=None):
+        t = self._clock() if t is None else float(t)
         fams = self._families()
         with self._lock:
             for slo in self._objectives:
@@ -302,8 +317,14 @@ class SLOEngine:
             # outside the lock: flight dumps walk telemetry state.
             # flight's own per-reason latch makes repeats no-ops until
             # the operator rearms, so a sustained burn dumps ONCE.
-            _flight.trigger(f"slo_burn:{name}",
-                            {"fast_burn": fast, "slow_burn": slow})
+            detail = {"fast_burn": fast, "slow_burn": slow}
+            if self._on_fast_burn is not None:
+                try:
+                    self._on_fast_burn(name, detail)
+                except Exception:
+                    pass           # a broken sink must not break eval
+            else:
+                _flight.trigger(f"slo_burn:{name}", detail)
         return out
 
     def fast_burning(self, t_now=None):

@@ -1,0 +1,320 @@
+"""Fleet observability plane: parse/merge semantics, the
+FleetCollector scrape cycle over an in-process HTTP fleet, stitched
+cross-worker trace assembly, the fleet-global SLO engine with its
+correlated fleet dump, and staleness handling
+(docs/OBSERVABILITY.md "Fleet observability").
+
+The merge bar: counters SUM across workers, gauges stay per-worker
+(worker_id/role labels), histograms merge BUCKET-WISE — percentiles
+are computed from the merged distribution, never averaged
+(tests/test_telemetry.py proves the estimator against numpy).
+"""
+import json
+import math
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu import telemetry
+from mxnet_tpu.models import GPT2Config, GPT2ForCausalLM
+from mxnet_tpu.serving import Request, ServingEngine, TokenStream
+from mxnet_tpu.serving.fleet import (FleetRouter, FleetWorker,
+                                     WorkerClient, warm_engine)
+from mxnet_tpu.serving.fleet.observe import (
+    FleetCollector, fleet_chrome_trace, merge_exports, parse_prometheus)
+
+_CONFIG = dict(vocab_size=97, units=32, num_layers=2, num_heads=2,
+               max_length=64, dropout=0.0, attention_dropout=0.0)
+_ENGINE = dict(num_slots=2, max_length=32, page_size=8, attn_impl="xla")
+
+_net_cache = {}
+
+
+def _tiny():
+    if "net" not in _net_cache:
+        cfg = GPT2Config(**_CONFIG)
+        mx.rng.seed(3)
+        net = GPT2ForCausalLM(cfg)
+        net.initialize(mx.init.Normal(0.05))
+        _net_cache["net"] = (net, cfg)
+    return _net_cache["net"]
+
+
+def _worker(role, wid=None):
+    net, cfg = _tiny()
+    eng = ServingEngine(net, **_ENGINE)
+    warm_engine(eng, cfg)
+    return FleetWorker(eng, role=role, worker_id=wid or f"{role}-t")
+
+
+def _run(router, prompts, n_new, tag):
+    reqs = [Request(list(p), n_new, request_id=f"{tag}{i}", seed=i,
+                    do_sample=bool(i % 2)) for i, p in enumerate(prompts)]
+    for r in reqs:
+        r.stream = TokenStream(capacity=64)
+        router.submit(r)
+    for r in reqs:
+        router.result(r, timeout=120)
+    return reqs
+
+
+# ---------------------------------------------------------------------------
+# exposition parse + merge semantics (pure text, no fleet)
+# ---------------------------------------------------------------------------
+
+_EXPORT_A = """\
+# HELP reqs_total requests
+# TYPE reqs_total counter
+reqs_total{engine="0"} 10
+# TYPE depth gauge
+depth{engine="0"} 3
+# TYPE lat_seconds histogram
+lat_seconds_bucket{engine="0",le="0.001"} 8
+lat_seconds_bucket{engine="0",le="1"} 8
+lat_seconds_bucket{engine="0",le="+Inf"} 8
+lat_seconds_sum{engine="0"} 0.008
+lat_seconds_count{engine="0"} 8
+"""
+
+_EXPORT_B = """\
+# TYPE reqs_total counter
+reqs_total{engine="0"} 32
+# TYPE depth gauge
+depth{engine="0"} 7
+# TYPE lat_seconds histogram
+lat_seconds_bucket{engine="0",le="0.001"} 0
+lat_seconds_bucket{engine="0",le="1"} 2
+lat_seconds_bucket{engine="0",le="+Inf"} 2
+lat_seconds_sum{engine="0"} 1.9
+lat_seconds_count{engine="0"} 2
+"""
+
+
+def test_parse_prometheus_structure():
+    fams = parse_prometheus(_EXPORT_A)
+    assert fams["reqs_total"]["kind"] == "counter"
+    assert fams["reqs_total"]["help"] == "requests"
+    assert fams["reqs_total"]["samples"] == [({"engine": "0"}, 10.0)]
+    h = fams["lat_seconds"]["hist"][(("engine", "0"),)]
+    assert h["bounds"] == [0.001, 1.0, math.inf]
+    assert h["cumulative"] == [8.0, 8.0, 8.0]
+    assert h["count"] == 8 and h["sum"] == pytest.approx(0.008)
+
+
+def test_merge_counters_sum_gauges_split_hists_bucketwise():
+    exports = [("wA", "prefill", parse_prometheus(_EXPORT_A)),
+               ("wB", "decode", parse_prometheus(_EXPORT_B))]
+    reg, conflicts = merge_exports(exports)
+    assert conflicts == []
+    # counters: one child per label set, values SUMMED
+    c = reg.get("reqs_total")
+    assert [(v, ch.value) for v, ch in c._samples()] \
+        == [(("0",), 42.0)]
+    # gauges: one child PER WORKER, never summed
+    g = reg.get("depth")
+    got = {v: ch.value for v, ch in g._samples()}
+    assert got == {("0", "wA", "prefill"): 3.0,
+                   ("0", "wB", "decode"): 7.0}
+    assert g.labelnames == ("engine", "worker_id", "role")
+    # histograms: merged bucket-wise — the p99 lives where the pooled
+    # distribution says, not between the two workers' p99s
+    h = reg.get("lat_seconds")
+    child = next(ch for _v, ch in h._samples())
+    assert child.count == 10
+    assert child.sum == pytest.approx(1.908)
+    assert child.percentile(99) > 0.001   # the slow worker's tail
+
+
+def test_merge_refuses_mismatched_buckets():
+    bad = _EXPORT_B.replace('le="0.001"', 'le="0.005"')
+    reg, conflicts = merge_exports(
+        [("wA", "prefill", parse_prometheus(_EXPORT_A)),
+         ("wB", "decode", parse_prometheus(bad))])
+    assert conflicts == ["lat_seconds"]
+    assert reg.get("lat_seconds") is None     # skipped, not mangled
+    assert reg.get("reqs_total") is not None  # others still merge
+
+
+# ---------------------------------------------------------------------------
+# the collector over a live in-process HTTP fleet
+# ---------------------------------------------------------------------------
+
+def test_collector_scrape_fleetz_and_endpoint():
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, 97, n).tolist() for n in (4, 9, 6)]
+    w1, w2 = _worker("mixed", "wm1"), _worker("mixed", "wm2")
+    router = FleetRouter([w1.url, w2.url])
+    coll = None
+    try:
+        reqs = _run(router, prompts, 6, "c")
+        assert all(r.status == "finished" for r in reqs)
+        coll = router.observe(interval_s=60.0)
+        assert router.observe() is coll          # idempotent
+        merged = coll.scrape()
+        # merged token counter == the sum over both workers' exports
+        want = sum(
+            sum(v for _l, v in parse_prometheus(
+                WorkerClient(w.url).metrics_text())
+                ["serving_tokens_emitted_total"]["samples"])
+            for w in (w1, w2))
+        got = sum(ch.value for _v, ch in
+                  merged.get("serving_tokens_emitted_total")._samples())
+        assert got == pytest.approx(want) and got >= len(reqs)
+        fz = coll.fleetz()
+        assert {r["worker_id"] for r in fz["workers"]} == {"wm1", "wm2"}
+        for row in fz["workers"]:
+            assert row["state"] == "ok" and row["scrape_errors"] == 0
+            assert row["steady_state_compiles"] == 0
+        assert fz["fleet"]["workers_total"] == 2
+        assert fz["fleet"]["workers_stale"] == 0
+        assert fz["router"]["workers_up"] == 2
+        assert fz["cycles"] >= 1
+        # the /fleetz route serves this collector's payload
+        srv = telemetry.IntrospectionServer(0)
+        try:
+            with urllib.request.urlopen(srv.url + "/fleetz",
+                                        timeout=30) as r:
+                body = json.loads(r.read())
+            assert body["collector"] == coll.cid
+            assert len(body["workers"]) == 2
+        finally:
+            srv.close()
+    finally:
+        router.close()                 # closes + unregisters collector
+        assert router.collector is None
+        w1.close(), w2.close()
+
+
+def test_disagg_trace_stitched_across_worker_tracks():
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, 97, n).tolist() for n in (5, 8)]
+    wp, wd = _worker("prefill", "wp"), _worker("decode", "wd")
+    router = FleetRouter([wp.url, wd.url])
+    try:
+        reqs = _run(router, prompts, 6, "d")
+        assert all(r.status == "finished" for r in reqs)
+        coll = router.observe(interval_s=60.0)
+        coll.scrape()
+        trace = coll.fleet_chrome_trace()
+        evs = trace["traceEvents"]
+        procs = {e["pid"]: e["args"]["name"] for e in evs
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert len(procs) == 2         # one track per worker, even
+        names = sorted(procs.values())  # with a shared in-process pid
+        assert any("(prefill)" in n for n in names)
+        assert any("(decode)" in n for n in names)
+        # each served request: ONE trace_id spanning BOTH pids
+        by_trace = {}
+        for e in evs:
+            if e.get("ph") == "X" and e.get("cat") == "request" \
+                    and str(e["args"].get("request_id", "")) \
+                    .startswith("d"):
+                by_trace.setdefault(e["args"]["trace_id"],
+                                    set()).add(e["pid"])
+        stitched = [t for t, pids in by_trace.items() if len(pids) >= 2]
+        assert len(stitched) == len(reqs)
+        # after clock alignment every track's timestamps are monotone
+        last = {}
+        for e in evs:
+            if e.get("ph") == "X":
+                k = (e["pid"], e["tid"])
+                assert e["ts"] >= last.get(k, -math.inf)
+                last[k] = e["ts"]
+        assert trace["otherData"]["clock_offsets_s"].keys() \
+            == {"wp", "wd"}
+    finally:
+        router.close()
+        wp.close(), wd.close()
+
+
+def test_fleet_slo_fast_burn_latches_one_correlated_dump(tmp_path):
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, 97, n).tolist() for n in (4, 7, 5)]
+    w1, w2 = _worker("mixed", "wx"), _worker("mixed", "wy")
+    router = FleetRouter([w1.url, w2.url])
+    coll = None
+    try:
+        reqs = _run(router, prompts, 5, "s")
+        # an impossible objective: every observed TTFT is "bad", so the
+        # fast window burns at 1/(1-target) >> fast_burn immediately
+        coll = FleetCollector(
+            [w1.url, w2.url], router=router, interval_s=60.0,
+            out_dir=str(tmp_path),
+            objectives=[telemetry.SLO("fleet_ttft", ttft_p99_ms=1e-6,
+                                      min_events=1)])
+        coll.scrape()
+        fz = coll.fleetz()
+        assert "fleet_ttft" in fz["slo"]["fast_burning"]
+        dumps = fz["fleet_dumps"]
+        assert len(dumps) == 1         # latched: scrape again, still 1
+        coll.scrape()
+        assert len(coll.fleetz()["fleet_dumps"]) == 1
+        d = dumps[0]
+        assert os.path.basename(d).startswith(
+            "fleet-slo_fleet_burn-fleet_ttft")
+        files = set(os.listdir(d))
+        assert {"merged.prom", "trace.json", "fleet.json"} <= files
+        for wid in ("wx", "wy"):       # one subdir per worker
+            sub = set(os.listdir(os.path.join(d, wid)))
+            assert {"metrics.prom", "stats.json", "requests.json",
+                    "sloz.json", "flightz.json"} <= sub
+        with open(os.path.join(d, "fleet.json")) as f:
+            assert json.load(f)["reason"] \
+                == "slo_fleet_burn:fleet_ttft"
+        # re-arm un-latches the reason: the same trigger dumps again
+        coll.rearm()
+        assert coll.fleet_dump("slo_fleet_burn:fleet_ttft") is not None
+        assert len(coll.fleetz()["fleet_dumps"]) == 2
+        assert len(reqs) == 3
+    finally:
+        if coll is not None:
+            coll.close()
+        router.close()
+        w1.close(), w2.close()
+
+
+def test_worker_flight_latch_mirrors_exactly_once(tmp_path):
+    w = _worker("mixed", "wl")
+    coll = None
+    try:
+        coll = FleetCollector([w.url], interval_s=60.0,
+                              out_dir=str(tmp_path))
+        coll.scrape()
+        view = coll.workers[0]
+        view.flightz = {"latched": ["stall:engine9"]}
+        coll._mirror_worker_latches()
+        coll._mirror_worker_latches()  # same latch: still one dump
+        dumps = coll.fleetz()["fleet_dumps"]
+        assert len(dumps) == 1
+        assert "worker-wl-stall-engine9" in os.path.basename(dumps[0])
+    finally:
+        if coll is not None:
+            coll.close()
+        w.close()
+
+
+def test_dead_worker_goes_stale_without_blocking(tmp_path):
+    w1, w2 = _worker("mixed", "wu"), _worker("mixed", "wv")
+    coll = FleetCollector([w1.url, w2.url], interval_s=60.0,
+                          scrape_timeout_s=2.0, out_dir=str(tmp_path))
+    try:
+        coll.scrape()
+        assert all(r["state"] == "ok"
+                   for r in coll.fleetz()["workers"])
+        w2.close()
+        coll.scrape()                  # must not raise
+        rows = {r["worker_id"]: r for r in coll.fleetz()["workers"]}
+        assert rows["wu"]["state"] == "ok"
+        assert rows["wv"]["state"] == "stale"
+        assert rows["wv"]["scrape_errors"] >= 1
+        assert rows["wv"]["last_error"]
+        assert coll.fleetz()["fleet"]["workers_stale"] == 1
+        # the dead worker's LAST GOOD families still feed the merge
+        assert 'worker_id="wv"' in coll.merged.render_prometheus()
+    finally:
+        coll.close()
+        w1.close()

@@ -75,6 +75,67 @@ def test_histogram_weighted_observe():
     assert h.percentile(99) <= 1.0
 
 
+def test_histogram_merge_vs_numpy_oracle():
+    """Bucket-wise merge (the fleet collector's combiner) must track
+    the percentile of the POOLED samples, on a split where averaging
+    per-worker percentiles is wildly wrong: worker A serves 99.5% of
+    traffic at ~1 ms, worker B 0.5% at ~1 s. The pooled p99 is still
+    ~1 ms (the slow worker owns under 1% of traffic), while
+    mean-of-p99s lands at ~500 ms — 500x off — regardless of the
+    traffic split."""
+    rng = np.random.default_rng(11)
+    fast = rng.lognormal(math.log(1e-3), 0.1, 995)
+    slow = rng.lognormal(math.log(1.0), 0.1, 5)
+    buckets = exponential_buckets(1e-5, 2.0, 26)
+    ha = Histogram("h", buckets=buckets)
+    hb = Histogram("h", buckets=buckets)
+    for v in fast:
+        ha.observe(v)
+    for v in slow:
+        hb.observe(v)
+    merged = Histogram("h", buckets=buckets).merge(ha).merge(hb)
+    pooled = np.concatenate([fast, slow])
+    assert merged.count == 1000
+    assert merged.count == ha.count + hb.count
+    assert merged.sum == pytest.approx(float(pooled.sum()), rel=1e-9)
+    for q in (50, 90, 99, 99.9):
+        oracle = float(np.percentile(pooled, q))
+        est = merged.percentile(q)
+        assert oracle / 2.05 <= est <= oracle * 2.05, (q, est, oracle)
+    # the strawman the merge exists to prevent: averaging worker p99s
+    avg_p99 = (ha.percentile(99) + hb.percentile(99)) / 2
+    oracle_p99 = float(np.percentile(pooled, 99))
+    assert not (oracle_p99 / 2.05 <= avg_p99 <= oracle_p99 * 2.05)
+    # mismatched bucket layouts must refuse, never silently mangle
+    with pytest.raises(MXNetError):
+        merged.merge(Histogram("h", buckets=(1.0, 2.0)))
+
+
+def test_histogram_from_cumulative_roundtrip():
+    """Exposition-format reconstruction (finite `le` bounds + trailing
+    +Inf cumulative count) must reproduce per-bucket counts exactly and
+    percentiles to one bucket's resolution — the path every scraped
+    worker histogram takes through the fleet collector."""
+    h = Histogram("h", buckets=(1e-3, 1e-2, 1e-1, 1.0))
+    rng = np.random.default_rng(3)
+    vals = rng.exponential(0.02, 500)
+    for v in vals:
+        h.observe(v)
+    cum, acc = [], 0
+    for c in h._counts:
+        acc += c
+        cum.append(acc)
+    back = Histogram.from_cumulative(h.buckets, cum, h.sum, h.count,
+                                     name="h")
+    assert back._counts == h._counts
+    assert back.count == h.count and back.sum == pytest.approx(h.sum)
+    for q in (50, 90, 99):
+        assert back.percentile(q) == pytest.approx(h.percentile(q),
+                                                   rel=1.0)
+    with pytest.raises(MXNetError):
+        Histogram.from_cumulative((1.0, 2.0), [3, 2, 5], 1.0, 5)
+
+
 def test_concurrent_increments_from_threads():
     reg = Registry()
     c = reg.counter("c_total")

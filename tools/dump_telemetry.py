@@ -221,16 +221,16 @@ def run_fleet():
     """A REAL cross-process fleet: prefill + decode worker
     subprocesses behind the wire protocol, a few streamed requests
     (every one crossing a prefill->decode handoff) — so the router's
-    fleet_* instruments carry real values in the dump. Returns
-    (router, per-worker /metrics aggregation) — the workers' own
-    telemetry lives in THEIR processes, so it is scraped over HTTP and
-    aggregated by family here, exactly what a fleet scrape config
-    would do."""
+    fleet_* instruments carry real values in the dump. The workers'
+    own telemetry lives in THEIR processes; the FleetCollector scrapes
+    and merges it (counters summed, gauges per-worker, histograms
+    bucket-wise) into one registry, exactly what a fleet scrape config
+    would see. Returns (router, fleet view) with the /fleetz payload
+    and the merged-family headline captured before the workers exit."""
     import numpy as np
 
     from mxnet_tpu.serving import Request, TokenStream
-    from mxnet_tpu.serving.fleet import (FleetRouter, WorkerClient,
-                                         spawn_fleet)
+    from mxnet_tpu.serving.fleet import FleetRouter, spawn_fleet
 
     spec = {"config": {"vocab_size": 97, "units": 32, "num_layers": 2,
                        "num_heads": 2, "max_length": 64, "dropout": 0.0,
@@ -239,7 +239,6 @@ def run_fleet():
             "engine": {"num_slots": 2, "max_length": 32, "page_size": 8,
                        "attn_impl": "xla"}}
     rng = np.random.default_rng(0)
-    agg = {"workers": [], "families": {}}
     with spawn_fleet(spec, roles=("prefill", "decode")) as procs:
         router = FleetRouter(procs.urls)
         reqs = [Request(rng.integers(0, 97, n).tolist(), 5, seed=i,
@@ -251,38 +250,18 @@ def run_fleet():
         for r in reqs:
             router.result(r, timeout=120)
         assert all(r.status == "finished" for r in reqs)
-        # scrape + aggregate each worker's /metrics across its port
-        for wp in procs.workers:
-            c = WorkerClient(wp.url)
-            text = c.metrics_text()
-            stats = c.stats()
-            agg["workers"].append({
-                "url": wp.url, "role": wp.role,
-                "worker_id": stats["worker_id"],
-                "handoffs": stats["handoffs"],
-                "steady_state_compiles":
-                    stats["stats"]["steady_state_compiles"],
-                "samples": sum(1 for ln in text.splitlines()
-                               if ln and not ln.startswith("#")),
-            })
-            seen = set()
-            for ln in text.splitlines():
-                if not ln or ln.startswith("#"):
-                    continue
-                name = ln.split("{", 1)[0].split(" ", 1)[0]
-                try:
-                    val = float(ln.rsplit(" ", 1)[1])
-                except ValueError:
-                    continue
-                fam = agg["families"].setdefault(
-                    name, {"samples": 0, "sum": 0.0, "workers": 0})
-                fam["samples"] += 1
-                fam["sum"] += val
-                if name not in seen:
-                    seen.add(name)
-                    fam["workers"] += 1
+        # one collector scrape over the live worker ports, then
+        # snapshot everything the headline needs before they exit
+        coll = router.observe(interval_s=0.5)
+        merged = coll.scrape()
+        tok = merged.get("serving_tokens_emitted_total")
+        tokens = (sum(c._value for _, c in tok._samples())
+                  if tok is not None else 0.0)
+        view = {"fleetz": coll.fleetz(),
+                "families": len(merged._instruments),
+                "tokens": tokens}
         router.close()
-    return router, agg
+    return router, view
 
 
 def run_tenants():
@@ -622,26 +601,27 @@ def main():
               f"noop {s['cancels_noop']}), "
               f"overflows {s['stream_overflows']}, {tail}")
     if fleet_agg is not None:
-        # the fleet headline: per-worker scrape summary + the router's
-        # own placement/handoff instruments (fleet_* in the snapshot
-        # above — worker-side counters only exist in their processes,
-        # hence the scrape aggregation)
-        for w in fleet_agg["workers"]:
+        # the fleet headline: per-worker rows from the collector's
+        # /fleetz payload + the router's own placement/handoff
+        # instruments (fleet_* in the snapshot above — worker-side
+        # counters only exist in their processes, hence the collector
+        # scrape/merge)
+        fz = fleet_agg["fleetz"]
+        for w in fz["workers"]:
             print(f"# fleet worker {w['worker_id']} ({w['role']}) "
-                  f"{w['url']}: {w['samples']} metric samples, "
+                  f"{w['url']}: {w['state']}, "
                   f"handoffs {w['handoffs']}, "
                   f"steady compiles {w['steady_state_compiles']}")
-        fams = fleet_agg["families"]
         ho = telemetry.get("fleet_handoff_seconds")
         rid = fleet_router._rid
         hs = ho.labels(rid) if ho is not None else None
         tail = (f"handoff p50 {hs.percentile(50) * 1e3:.1f} ms"
                 if hs is not None and hs.count else "no handoff samples")
-        print(f"# fleet: {len(fleet_agg['workers'])} workers scraped, "
-              f"{len(fams)} metric families aggregated "
+        print(f"# fleet: {len(fz['workers'])} workers scraped "
+              f"({fz['fleet']['workers_stale']} stale), "
+              f"{fleet_agg['families']} metric families merged "
               f"(e.g. serving_tokens_emitted_total "
-              f"{fams.get('serving_tokens_emitted_total', {}).get('sum', 0):.0f} "
-              f"across the fleet), {tail}")
+              f"{fleet_agg['tokens']:.0f} across the fleet), {tail}")
     if args.cost:
         # the /compilez + /memz headline, human-shaped: where every
         # dispatched program sits on the roofline and where HBM went

@@ -245,9 +245,24 @@ def _main_fleet(args):
                  for r in ref_reqs}
     assert all(r.status == "finished" for r in ref_reqs)
 
-    print(f"# --fleet: spawning {args.replicas} mixed workers "
-          f"(kv_dtype={args.kv_dtype}) ...", file=sys.stderr)
-    procs = spawn_fleet(spec, roles=("mixed",) * args.replicas)
+    if args.disagg:
+        # disaggregated lane: one prefill worker, the rest decode —
+        # every admitted request crosses a handoff, which is what the
+        # stitched-trace verdict needs. The seeded SIGKILL is off here
+        # (killing the only prefill worker leaves nothing to fail over
+        # to); the mixed lane keeps owning the chaos story.
+        if args.replicas < 2:
+            raise SystemExit("--disagg needs --replicas >= 2")
+        if args.kill_after > 0:
+            print("# --disagg: disabling the seeded SIGKILL "
+                  "(single prefill worker)", file=sys.stderr)
+            args.kill_after = 0
+        roles = ("prefill",) + ("decode",) * (args.replicas - 1)
+    else:
+        roles = ("mixed",) * args.replicas
+    print(f"# --fleet: spawning {args.replicas} {'/'.join(roles)} "
+          f"workers (kv_dtype={args.kv_dtype}) ...", file=sys.stderr)
+    procs = spawn_fleet(spec, roles=roles)
 
     failures = []
 
@@ -280,6 +295,10 @@ def _main_fleet(args):
             time.sleep(0.005)
 
     router = FleetRouter(procs.urls)
+    # the fleet observability plane rides along the whole soak: the
+    # collector scrapes/merges every worker over the control plane and
+    # the verdict below judges its trace/staleness contracts
+    coll = router.observe(interval_s=0.5, scrape_timeout_s=5.0)
     clients = []
     for i, (beh, body) in enumerate(zip(behaviors, bodies)):
         tp = f"00-{i + 1:032x}-{i + 1:016x}-01"
@@ -457,6 +476,91 @@ def _main_fleet(args):
         check(identical > 0,
               "no fully-read stream survived to judge bit-identity")
 
+        # -- trace/observe-plane verdict ---------------------------------
+        # one final scrape over whatever is still alive, then judge the
+        # collector's contracts: clean runs scrape error-free, SIGKILL
+        # runs flag the victim stale (never fatal to the scrape loop),
+        # and the assembled fleet trace is clock-aligned
+        coll.scrape()
+        fz = coll.fleetz()
+        scrape_errors = {w["url"]: w["scrape_errors"]
+                         for w in fz["workers"]}
+        if kill_note["fired"]:
+            vrow = [w for w in fz["workers"]
+                    if w["url"] == procs.workers[victim_idx].url]
+            check(vrow and (vrow[0]["state"] == "stale"
+                            or vrow[0]["scrape_errors"] > 0),
+                  f"killed worker not flagged stale in /fleetz: {vrow}")
+        else:
+            check(sum(scrape_errors.values()) == 0,
+                  f"fleet_scrape_errors_total != 0 on a clean run: "
+                  f"{scrape_errors}")
+        tr = coll.fleet_chrome_trace()
+        tracks, order_bad = {}, []
+        for ev in tr["traceEvents"]:
+            if ev.get("ph") == "X":
+                tracks.setdefault((ev["pid"], ev["tid"]),
+                                  []).append(ev["ts"])
+        for k, tss in tracks.items():
+            if tss != sorted(tss):
+                order_bad.append(k)
+        check(not order_bad,
+              f"per-track timestamps not monotone after clock "
+              f"alignment: {order_bad[:4]}")
+        by_trace, finished, track_trace = {}, set(), {}
+        for ev in tr["traceEvents"]:
+            if ev.get("ph") != "X" or ev.get("cat") != "request":
+                continue
+            a = ev.get("args") or {}
+            tid_ = a.get("trace_id")
+            if not tid_:
+                continue
+            track_trace[(ev["pid"], ev["tid"])] = tid_
+            if str(a.get("request_id", "")).startswith("soak-"):
+                by_trace.setdefault(tid_, set()).add(ev["pid"])
+                if a.get("status") == "finished":
+                    finished.add(tid_)
+        stitched = [t for t in finished if len(by_trace[t]) >= 2]
+        if args.disagg:
+            unstitched = sorted(finished - set(stitched))
+            check(bool(finished) and not unstitched,
+                  f"disagg stitched-trace bar: {len(unstitched)} of "
+                  f"{len(finished)} finished soak traces do not span "
+                  f">=2 worker processes")
+            # alignment sanity per stitched request: the adopting
+            # track's first phase span must not begin measurably
+            # before the source track's last one ends (the gap between
+            # them IS the handoff wire flight — negative beyond clock
+            # slack means the aligned axes disagree)
+            spans = {}
+            for ev in tr["traceEvents"]:
+                if ev.get("ph") != "X" or ev.get("cat") != "phase":
+                    continue
+                t = track_trace.get((ev["pid"], ev["tid"]))
+                if t in finished and len(by_trace.get(t, ())) >= 2:
+                    spans.setdefault(t, {}).setdefault(
+                        ev["pid"], []).append(
+                        (ev["ts"], ev["ts"] + ev["dur"]))
+            for t, per_pid in spans.items():
+                if len(per_pid) < 2:
+                    continue
+                pids = sorted(per_pid, key=lambda p: min(
+                    a for a, _ in per_pid[p]))
+                src_end = max(b for _, b in per_pid[pids[0]])
+                dst_start = min(a for a, _ in per_pid[pids[-1]])
+                check(dst_start - src_end > -100e3,
+                      f"trace {t}: adopting track begins "
+                      f"{(src_end - dst_start) / 1e3:.1f} ms before "
+                      f"the source track ends (clock alignment)")
+        observe_row = {
+            "scrape_errors": scrape_errors,
+            "workers_stale": fz["fleet"]["workers_stale"],
+            "tracks": len(tracks),
+            "finished_soak_traces": len(finished),
+            "stitched_cross_worker": len(stitched),
+            "fleet_dumps": fz["fleet_dumps"],
+        }
+
         fe.shutdown(timeout=60)
         check(not fe._loop_thread.is_alive(), "serving loop still alive")
     finally:
@@ -468,6 +572,8 @@ def _main_fleet(args):
         "mode": "fleet",
         "requests": args.requests,
         "replicas": args.replicas,
+        "disagg": bool(args.disagg),
+        "observe": observe_row,
         "kv_dtype": args.kv_dtype,
         "soak_seconds": round(soak_s, 3),
         "requests_by_code": by_code,
@@ -551,9 +657,20 @@ def main(argv=None):
                          "process mid-decode (--kill-after then means: "
                          "kill once the victim has emitted that many "
                          "tokens with a decode in flight)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="with --fleet: disaggregated roles (one "
+                         "prefill worker, the rest decode) so every "
+                         "admitted request crosses a prefill->decode "
+                         "handoff — the verdict then asserts each "
+                         "finished request's stitched trace spans >=2 "
+                         "worker processes on the collector's clock-"
+                         "aligned fleet trace (disables the seeded "
+                         "SIGKILL: there is only one prefill worker)")
     ap.add_argument("--json", default=None,
                     help="also write the summary JSON to this path")
     args = ap.parse_args(argv)
+    if args.disagg and not args.fleet:
+        ap.error("--disagg requires --fleet")
     if args.fleet:
         if args.tp > 1 or args.hbm_budget_bytes is not None \
                 or args.host_budget_bytes is not None:

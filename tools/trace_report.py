@@ -2,8 +2,8 @@
 """Render TTFT phase waterfalls from a Chrome trace dump.
 
 The serving engine decomposes every request's time-to-first-token into
-the five budget phases of `telemetry.PHASES` (queue_wait,
-prefix_match, host_pagein, prefill_chunks, first_decode —
+the budget phases of `telemetry.PHASES` (queue_wait, prefix_match,
+host_pagein, prefill_chunks, first_decode, handoff —
 docs/OBSERVABILITY.md "TTFT phase taxonomy") and exports them as
 `cat="phase"` complete events in the Chrome trace
 (`telemetry.chrome_trace()`, `/trace`, `dump_telemetry.py --trace`).
@@ -21,10 +21,18 @@ terminal:
     mean / max per phase — the fleet-level budget split that tells
     you which phase to optimize next.
 
+`--fleet` reads a multi-worker Perfetto export
+(`FleetCollector.fleet_chrome_trace()` — one process track per worker,
+clock-aligned): waterfalls fold a disaggregated request's spans from
+BOTH worker tracks into one timeline, each span annotated with its
+worker, and the prefill->decode handoff gap (last span ending on the
+source track to first span starting on the destination track) is
+labelled under the waterfall.
+
 Usage:
     python tools/dump_telemetry.py --trace trace.json
     python tools/trace_report.py trace.json [--top 8] [--width 40]
-        [--share-only]
+        [--share-only] [--fleet]
 
 Exit codes: 0 = rendered, 2 = unreadable input or no phase events in
 the trace (nothing served, or the request log was disabled).
@@ -39,9 +47,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # phase display order = budget order; mirrors telemetry.PHASES without
 # importing jax (this tool must run on a bare trace file anywhere)
 PHASE_ORDER = ("queue_wait", "prefix_match", "host_pagein",
-               "prefill_chunks", "first_decode")
+               "prefill_chunks", "first_decode", "handoff")
 
-__all__ = ["load_events", "collect", "main"]
+__all__ = ["load_events", "collect", "worker_of", "handoff_gaps", "main"]
 
 
 def load_events(path):
@@ -50,11 +58,15 @@ def load_events(path):
     return obj["traceEvents"] if isinstance(obj, dict) else obj
 
 
-def collect(events):
+def collect(events, by_trace=False):
     """({request_name: [phase event, ...]}, {(pid, tid): request_name},
     {pid: engine_name}) from one trace. Grouping by the request's
     display name ("req <id>") folds a migrated request's engines into
-    one timeline."""
+    one timeline. `by_trace` (fleet mode) groups by the stitched
+    `trace_id` carried on each request slice instead — a disaggregated
+    request's prefill and decode tracks fold because they share one
+    trace, while unrelated requests that merely reuse an id on
+    different workers (each worker's warmup, say) stay separate."""
     threads, procs = {}, {}
     for ev in events:
         if ev.get("ph") != "M":
@@ -63,14 +75,68 @@ def collect(events):
             threads[(ev.get("pid"), ev.get("tid"))] = ev["args"]["name"]
         elif ev.get("name") == "process_name":
             procs[ev.get("pid")] = ev["args"]["name"]
-    by_req = {}
+    trace_of = {}
+    if by_trace:
+        for ev in events:
+            if ev.get("ph") == "X" and ev.get("cat") == "request":
+                t = (ev.get("args") or {}).get("trace_id")
+                if t:
+                    trace_of[(ev.get("pid"), ev.get("tid"))] = t
+    grouped, label = {}, {}
     for ev in events:
         if ev.get("cat") != "phase" or ev.get("ph") != "X":
             continue
-        key = threads.get((ev.get("pid"), ev.get("tid")),
-                          f"tid {ev.get('tid')}")
-        by_req.setdefault(key, []).append(ev)
+        tk = (ev.get("pid"), ev.get("tid"))
+        name = threads.get(tk, f"tid {ev.get('tid')}")
+        key = trace_of.get(tk, name) if by_trace else name
+        grouped.setdefault(key, []).append(ev)
+        label.setdefault(key, name)
+    by_req, taken = {}, {}
+    for key, evs in grouped.items():
+        disp = label[key]
+        if taken.get(disp, key) != key:   # same id, different trace
+            disp = f"{disp} [{str(key)[:8]}]"
+        taken.setdefault(disp, key)
+        by_req[disp] = evs
     return by_req, threads, procs
+
+
+def worker_of(proc_name):
+    """Short worker id from a fleet process track name. The fleet
+    assembler (`fleet_chrome_trace`) names tracks
+    "worker <id> (<role>) pid <pid>"; single-engine traces name them
+    "engine <n>" — returned unchanged."""
+    if isinstance(proc_name, str) and proc_name.startswith("worker "):
+        return proc_name.split(" ", 2)[1]
+    return proc_name
+
+
+def handoff_gaps(by_req, procs):
+    """{request_name: (src_worker, dst_worker, gap_us)} for every
+    request whose phase spans sit on more than one process track — the
+    disaggregated prefill->decode picture. The gap is the wall time
+    between the last span ending on the source track and the first
+    span starting on the destination track (the wire flight + adopt
+    ack the decode side's own "handoff" phase brackets); negative
+    means the tracks overlap, which after clock alignment indicates
+    the source kept serving while the adopter resumed."""
+    out = {}
+    for name, evs in by_req.items():
+        by_pid = {}
+        for ev in evs:
+            by_pid.setdefault(ev.get("pid"), []).append(ev)
+        if len(by_pid) < 2:
+            continue
+        # order tracks by when the request first appears on them
+        order = sorted(by_pid, key=lambda p: min(e["ts"]
+                                                 for e in by_pid[p]))
+        src, dst = order[0], order[-1]
+        src_end = max(e["ts"] + e["dur"] for e in by_pid[src])
+        dst_start = min(e["ts"] for e in by_pid[dst])
+        out[name] = (worker_of(procs.get(src, f"pid {src}")),
+                     worker_of(procs.get(dst, f"pid {dst}")),
+                     dst_start - src_end)
+    return out
 
 
 def _bar(offset, dur, total, width):
@@ -92,10 +158,12 @@ def _phase_key(name):
         return (len(PHASE_ORDER), name)
 
 
-def render_waterfalls(by_req, procs, top, width, out=print):
+def render_waterfalls(by_req, procs, top, width, fleet=False,
+                      out=print):
     # slowest first: ranked by summed phase time (the TTFT budget)
     ranked = sorted(by_req.items(),
                     key=lambda kv: -sum(e["dur"] for e in kv[1]))
+    gaps = handoff_gaps(by_req, procs) if fleet else {}
     for name, evs in ranked[:top]:
         t0 = min(e["ts"] for e in evs)
         t1 = max(e["ts"] + e["dur"] for e in evs)
@@ -103,16 +171,28 @@ def render_waterfalls(by_req, procs, top, width, out=print):
         engines = sorted({procs.get(e.get("pid"), f"pid {e.get('pid')}")
                           for e in evs})
         budget = sum(e["dur"] for e in evs)
+        tag = ""
+        if len(engines) > 1:
+            tag = "  [stitched]" if fleet else "  [migrated]"
         out(f"{name}  ({', '.join(engines)})  "
-            f"phase budget {budget / 1e3:.1f} ms"
-            + ("  [migrated]" if len(engines) > 1 else ""))
+            f"phase budget {budget / 1e3:.1f} ms" + tag)
         for ev in sorted(evs, key=lambda e: (e["ts"],
                                              _phase_key(e["name"]))):
             extra = "".join(f" {k}={v}" for k, v in
                             sorted((ev.get("args") or {}).items()))
+            track = ""
+            if fleet:
+                w = worker_of(procs.get(ev.get("pid"),
+                                        f"pid {ev.get('pid')}"))
+                track = f" @{w}"
             out(f"  {ev['name']:<15}{ev['dur'] / 1e3:>9.2f} ms  "
                 f"|{_bar(ev['ts'] - t0, ev['dur'], total, width)}|"
-                f"{extra}")
+                f"{track}{extra}")
+        if name in gaps:
+            src, dst, gap = gaps[name]
+            out(f"  handoff gap     {gap / 1e3:>8.2f} ms  "
+                f"{src} -> {dst}"
+                + ("  [tracks overlap]" if gap < 0 else ""))
         out("")
 
 
@@ -148,6 +228,13 @@ def main(argv=None):
     ap.add_argument("--share-only", action="store_true",
                     help="skip the waterfalls, print only the "
                          "phase-share table")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet mode for a multi-worker Perfetto "
+                         "export (FleetCollector.fleet_chrome_trace): "
+                         "annotate each phase span with its worker "
+                         "track, tag cross-worker requests "
+                         "[stitched], and label the prefill->decode "
+                         "handoff gap between process tracks")
     args = ap.parse_args(argv)
 
     try:
@@ -155,16 +242,22 @@ def main(argv=None):
     except (OSError, ValueError) as e:
         print(f"ERROR: cannot read {args.trace}: {e}")
         return 2
-    by_req, _, procs = collect(events)
+    by_req, _, procs = collect(events, by_trace=args.fleet)
     if not by_req:
         print("ERROR: no phase events in the trace — nothing was "
               "served, or telemetry.request_log was disabled")
         return 2
     n_ph = sum(len(v) for v in by_req.values())
-    print(f"# {len(by_req)} request(s), {n_ph} phase spans "
-          f"({os.path.basename(args.trace)})\n")
+    head = f"# {len(by_req)} request(s), {n_ph} phase spans "
+    if args.fleet:
+        workers = sorted({worker_of(v) for v in procs.values()})
+        stitched = handoff_gaps(by_req, procs)
+        head += (f"across {len(workers)} worker track(s), "
+                 f"{len(stitched)} stitched cross-worker ")
+    print(head + f"({os.path.basename(args.trace)})\n")
     if not args.share_only:
-        render_waterfalls(by_req, procs, args.top, max(10, args.width))
+        render_waterfalls(by_req, procs, args.top, max(10, args.width),
+                          fleet=args.fleet)
     render_share(by_req)
     return 0
 
